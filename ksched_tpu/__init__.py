@@ -1,0 +1,13 @@
+"""ksched_tpu: a TPU-native flow-network cluster scheduler.
+
+A ground-up rebuild of the capabilities of ksched (a Go reimplementation
+of the Firmament min-cost max-flow scheduler): scheduling is modeled as
+min-cost max-flow over a task → equivalence-class → resource-topology →
+sink network, with per-job unscheduled-aggregator escape nodes. Instead
+of streaming DIMACS text to an external C++ solver subprocess, the flow
+network lives in flat device arrays and is solved by a JAX/Pallas
+cost-scaling push-relabel kernel on TPU (with exact CPU and native C++
+backends behind the same solver seam).
+"""
+
+__version__ = "0.1.0"

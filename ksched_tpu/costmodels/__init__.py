@@ -1,0 +1,10 @@
+from .base import CLUSTER_AGGREGATOR_EC, Cost, CostModeler, CostModelType
+from .trivial import TrivialCostModel
+
+__all__ = [
+    "CLUSTER_AGGREGATOR_EC",
+    "Cost",
+    "CostModeler",
+    "CostModelType",
+    "TrivialCostModel",
+]

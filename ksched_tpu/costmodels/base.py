@@ -1,0 +1,154 @@
+"""L4: the pluggable cost-model (policy) interface.
+
+Reference: scheduling/flow/costmodel/interface.go:27-136. The 16-method
+surface is kept intact — arc costs, preference/EC enumeration, lifecycle
+hooks, and the stats traversal — because the graph manager drives policy
+exclusively through it. TPU-specific extension: cost models may override
+the vectorized batch hooks (``ec_to_resource_batch`` etc.) to emit whole
+cost/capacity arrays at once for the array fast path; the default
+implementations fan out to the scalar methods.
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+from typing import List, Optional, Sequence, Tuple, TYPE_CHECKING
+
+from ..data import ResourceDescriptor, ResourceTopologyNodeDescriptor
+from ..utils import equiv_class_from_bytes
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..graph.flowgraph import Node
+
+
+class CostModelType(enum.IntEnum):
+    """Reference: costmodel/interface.go:33-43."""
+
+    TRIVIAL = 0
+    RANDOM = 1
+    SJF = 2
+    QUINCY = 3
+    WHARE = 4
+    COCO = 5
+    OCTOPUS = 6
+    VOID = 7
+    NET = 8
+
+
+# The wildcard equivalence class every task points at in aggregate-style
+# cost models (reference: costmodel/interface.go:46).
+CLUSTER_AGGREGATOR_EC = equiv_class_from_bytes(b"CLUSTER_AGG")
+
+Cost = int
+
+
+class CostModeler(abc.ABC):
+    """Reference: costmodel/interface.go:54-136."""
+
+    # -- arc costs --------------------------------------------------------
+
+    @abc.abstractmethod
+    def task_to_unscheduled_agg_cost(self, task_id: int) -> Cost:
+        """Cost of leaving the task unscheduled this round; should rise
+        monotonically across rounds so starvation is bounded."""
+
+    @abc.abstractmethod
+    def unscheduled_agg_to_sink_cost(self, job_id: int) -> Cost: ...
+
+    @abc.abstractmethod
+    def task_to_resource_node_cost(self, task_id: int, resource_id: int) -> Cost: ...
+
+    @abc.abstractmethod
+    def resource_node_to_resource_node_cost(
+        self, source: Optional[ResourceDescriptor], destination: ResourceDescriptor
+    ) -> Cost: ...
+
+    @abc.abstractmethod
+    def leaf_resource_node_to_sink_cost(self, resource_id: int) -> Cost: ...
+
+    @abc.abstractmethod
+    def task_continuation_cost(self, task_id: int) -> Cost: ...
+
+    @abc.abstractmethod
+    def task_preemption_cost(self, task_id: int) -> Cost: ...
+
+    @abc.abstractmethod
+    def task_to_equiv_class_aggregator(self, task_id: int, ec: int) -> Cost: ...
+
+    @abc.abstractmethod
+    def equiv_class_to_resource_node(self, ec: int, resource_id: int) -> Tuple[Cost, int]:
+        """Returns (cost, capacity); capacity is typically free slots below."""
+
+    @abc.abstractmethod
+    def equiv_class_to_equiv_class(self, ec1: int, ec2: int) -> Tuple[Cost, int]: ...
+
+    # -- preference enumeration -------------------------------------------
+
+    @abc.abstractmethod
+    def get_task_equiv_classes(self, task_id: int) -> List[int]: ...
+
+    @abc.abstractmethod
+    def get_outgoing_equiv_class_pref_arcs(self, ec: int) -> List[int]: ...
+
+    @abc.abstractmethod
+    def get_task_preference_arcs(self, task_id: int) -> List[int]: ...
+
+    @abc.abstractmethod
+    def get_equiv_class_to_equiv_classes_arcs(self, ec: int) -> List[int]: ...
+
+    # -- lifecycle --------------------------------------------------------
+
+    @abc.abstractmethod
+    def add_machine(self, rtnd: ResourceTopologyNodeDescriptor) -> None: ...
+
+    @abc.abstractmethod
+    def add_task(self, task_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def remove_machine(self, resource_id: int) -> None: ...
+
+    @abc.abstractmethod
+    def remove_task(self, task_id: int) -> None: ...
+
+    # -- stats traversal (reverse BFS from the sink) ----------------------
+
+    @abc.abstractmethod
+    def gather_stats(self, accumulator: "Node", other: "Node") -> "Node": ...
+
+    @abc.abstractmethod
+    def prepare_stats(self, accumulator: "Node") -> None: ...
+
+    @abc.abstractmethod
+    def update_stats(self, accumulator: "Node", other: "Node") -> "Node": ...
+
+    # -- debug ------------------------------------------------------------
+
+    def debug_info(self) -> str:
+        return ""
+
+    def debug_info_csv(self) -> str:
+        return ""
+
+    # -- vectorized batch hooks (TPU fast path; optional overrides) -------
+
+    def ec_to_resource_batch(
+        self, ec: int, resource_ids: Sequence[int]
+    ) -> Tuple[List[Cost], List[int]]:
+        """Batch form of equiv_class_to_resource_node: returns parallel
+        (costs, capacities) lists for all given resources."""
+        costs: List[Cost] = []
+        caps: List[int] = []
+        for rid in resource_ids:
+            c, cap = self.equiv_class_to_resource_node(ec, rid)
+            costs.append(c)
+            caps.append(cap)
+        return costs, caps
+
+    def task_to_unscheduled_agg_cost_batch(self, task_ids: Sequence[int]) -> List[Cost]:
+        return [self.task_to_unscheduled_agg_cost(t) for t in task_ids]
+
+    def task_to_equiv_class_aggregator_batch(
+        self, task_ids: Sequence[int], ec: int
+    ) -> List[Cost]:
+        return [self.task_to_equiv_class_aggregator(t, ec) for t in task_ids]

@@ -1,0 +1,25 @@
+"""Scheduling deltas: the round's output diff.
+
+Reference: proto/scheduling_delta.proto:10-21. A scheduling round emits a
+set of deltas (PLACE / PREEMPT / MIGRATE / NOOP) that the service layer
+applies to its bindings and pushes to the cluster adapter.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class DeltaType(enum.IntEnum):
+    PLACE = 0
+    PREEMPT = 1
+    MIGRATE = 2
+    NOOP = 3
+
+
+@dataclass(frozen=True)
+class SchedulingDelta:
+    type: DeltaType
+    task_id: int
+    resource_id: str

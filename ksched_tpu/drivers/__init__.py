@@ -1,0 +1,19 @@
+from .synthetic import (
+    add_job,
+    add_machine,
+    add_task_to_job,
+    build_cluster,
+    build_machine_topology,
+    make_coordinator_root,
+    make_resource_desc,
+)
+
+__all__ = [
+    "add_job",
+    "add_machine",
+    "add_task_to_job",
+    "build_cluster",
+    "build_machine_topology",
+    "make_coordinator_root",
+    "make_resource_desc",
+]

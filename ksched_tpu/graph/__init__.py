@@ -1,0 +1,21 @@
+from .changes import ChangeManager, ChangeStats, ChangeType
+from .device_export import DeviceGraphState, FlowProblem
+from .flowgraph import Arc, ArcType, FlowGraph, Node, NodeType, resource_node_type
+from .graph_manager import GraphManager, TaskMapping, task_needs_node
+
+__all__ = [
+    "ChangeManager",
+    "ChangeStats",
+    "ChangeType",
+    "DeviceGraphState",
+    "FlowProblem",
+    "Arc",
+    "ArcType",
+    "FlowGraph",
+    "Node",
+    "NodeType",
+    "resource_node_type",
+    "GraphManager",
+    "TaskMapping",
+    "task_needs_node",
+]

@@ -1,0 +1,217 @@
+"""Device-array graph state: the TPU-native replacement for the DIMACS wire.
+
+Where the reference streams DIMACS text to a solver subprocess
+(scheduling/flow/placement/solver.go:92-123), the TPU build keeps the
+flow network as flat structure-of-arrays buffers whose row indices ARE
+the flow-graph node ids (dense + recycled, see graph/flowgraph.py). A
+full build converts the host graph once; afterwards the per-round change
+journal (graph/changes.py) is scattered into the arrays in place, so the
+cost of preparing a round's solve tracks the delta, not the graph — the
+same property the reference gets from Flowlessly's incremental daemon
+mode.
+
+Arrays are padded to power-of-two extents so repeated jit solves reuse
+the same compiled executable as the cluster grows (XLA static shapes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .changes import AddNodeChange, Change, ChangeArcChange, NewArcChange, RemoveNodeChange
+from .flowgraph import FlowGraph, NodeType
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass
+class FlowProblem:
+    """A min-cost max-flow instance in flat arrays.
+
+    Row 0 of the node arrays is a padding row (graph node ids start at 1).
+    Arc lower bounds are already folded into ``excess`` via the standard
+    transformation; ``flow_offset`` holds the folded lower bound per arc so
+    decoded flows can be restored (decoded_flow = solver_flow + flow_offset).
+    """
+
+    num_nodes: int  # dense extent including padding row
+    excess: np.ndarray  # int64[N] supply(+)/demand(-) after lower-bound fold
+    node_type: np.ndarray  # int8[N] NodeType, -1 for invalid rows
+    src: np.ndarray  # int32[M]
+    dst: np.ndarray  # int32[M]
+    cap: np.ndarray  # int32[M] residual upper bound after lower-bound fold
+    cost: np.ndarray  # int32[M]
+    flow_offset: np.ndarray  # int32[M] folded lower bounds
+    num_arcs: int  # live arc slots (<= len(src))
+
+    @property
+    def total_supply(self) -> int:
+        return int(self.excess[self.excess > 0].sum())
+
+
+class DeviceGraphState:
+    """Maintains the padded flat arrays + the (src, dst) → arc-slot map.
+
+    ``full_build`` constructs arrays from a host FlowGraph; ``apply_changes``
+    scatters a change journal into them. Freed arc slots are recycled.
+    """
+
+    def __init__(self) -> None:
+        self.n_cap = 0  # padded node extent
+        self.m_cap = 0  # padded arc extent
+        self.excess: Optional[np.ndarray] = None
+        self.node_type: Optional[np.ndarray] = None
+        self.src: Optional[np.ndarray] = None
+        self.dst: Optional[np.ndarray] = None
+        self.cap: Optional[np.ndarray] = None
+        self.low: Optional[np.ndarray] = None
+        self.cost: Optional[np.ndarray] = None
+        self._arc_slot: Dict[Tuple[int, int], int] = {}
+        self._free_slots: List[int] = []
+        self._num_slots = 0
+        self.num_nodes = 0
+        self.generation = 0  # bumped when padded extents change (recompile signal)
+
+    # -- construction -----------------------------------------------------
+
+    def _alloc(self, n: int, m: int) -> None:
+        self.n_cap = max(_next_pow2(n), 16)
+        self.m_cap = max(_next_pow2(m), 16)
+        self.excess = np.zeros(self.n_cap, dtype=np.int64)
+        self.node_type = np.full(self.n_cap, -1, dtype=np.int8)
+        self.src = np.zeros(self.m_cap, dtype=np.int32)
+        self.dst = np.zeros(self.m_cap, dtype=np.int32)
+        self.cap = np.zeros(self.m_cap, dtype=np.int32)
+        self.low = np.zeros(self.m_cap, dtype=np.int32)
+        self.cost = np.zeros(self.m_cap, dtype=np.int32)
+        self.generation += 1
+
+    def full_build(self, graph: FlowGraph) -> None:
+        n = graph.max_node_id
+        m = graph.num_arcs
+        self._alloc(n, m)
+        self._arc_slot.clear()
+        self._free_slots.clear()
+        self._num_slots = 0
+        self.num_nodes = n
+        for node in graph.nodes():
+            self.excess[node.id] = node.excess
+            self.node_type[node.id] = int(node.type)
+        for arc in graph.arcs():
+            self._set_arc(arc.src, arc.dst, arc.cap_lower, arc.cap_upper, arc.cost)
+
+    # -- incremental updates ----------------------------------------------
+
+    def _grow_nodes(self, need: int) -> None:
+        new_cap = _next_pow2(need)
+        if new_cap <= self.n_cap:
+            return
+        self.excess = np.concatenate([self.excess, np.zeros(new_cap - self.n_cap, np.int64)])
+        self.node_type = np.concatenate(
+            [self.node_type, np.full(new_cap - self.n_cap, -1, np.int8)]
+        )
+        self.n_cap = new_cap
+        self.generation += 1
+
+    def _grow_arcs(self, need: int) -> None:
+        new_cap = _next_pow2(need)
+        if new_cap <= self.m_cap:
+            return
+        pad = new_cap - self.m_cap
+        for name in ("src", "dst", "cap", "low", "cost"):
+            arr = getattr(self, name)
+            setattr(self, name, np.concatenate([arr, np.zeros(pad, arr.dtype)]))
+        self.m_cap = new_cap
+        self.generation += 1
+
+    def _take_slot(self) -> int:
+        if self._free_slots:
+            return self._free_slots.pop()
+        slot = self._num_slots
+        self._grow_arcs(slot + 1)
+        self._num_slots += 1
+        return slot
+
+    def _set_arc(self, src: int, dst: int, low: int, cap: int, cost: int) -> None:
+        key = (src, dst)
+        slot = self._arc_slot.get(key)
+        if cap == 0 and low == 0:
+            if slot is not None:
+                self.cap[slot] = 0
+                self.low[slot] = 0
+                self.cost[slot] = 0
+                self.src[slot] = 0
+                self.dst[slot] = 0
+                del self._arc_slot[key]
+                self._free_slots.append(slot)
+            return
+        if slot is None:
+            slot = self._take_slot()
+            self._arc_slot[key] = slot
+        self.src[slot] = src
+        self.dst[slot] = dst
+        self.cap[slot] = cap
+        self.low[slot] = low
+        self.cost[slot] = cost
+
+    def apply_changes(self, changes: List[Change]) -> None:
+        for ch in changes:
+            if isinstance(ch, AddNodeChange):
+                self._grow_nodes(ch.node_id + 1)
+                self.excess[ch.node_id] = ch.excess
+                self.node_type[ch.node_id] = int(ch.node_type)
+                self.num_nodes = max(self.num_nodes, ch.node_id + 1)
+            elif isinstance(ch, RemoveNodeChange):
+                self.excess[ch.node_id] = 0
+                self.node_type[ch.node_id] = -1
+            elif isinstance(ch, (NewArcChange, ChangeArcChange)):
+                self._set_arc(ch.src, ch.dst, ch.cap_lower, ch.cap_upper, ch.cost)
+            else:  # pragma: no cover
+                raise TypeError(f"unknown change record: {ch!r}")
+
+    def set_excess(self, node_id: int, excess: int) -> None:
+        """Sink-excess bookkeeping happens outside the journal in the
+        reference (graph_manager.go:636-640); mirror of that path."""
+        self.excess[node_id] = excess
+
+    # -- solver view ------------------------------------------------------
+
+    def problem(self) -> FlowProblem:
+        """Materialize the lower-bound-folded FlowProblem view.
+
+        Copies the arrays (cheap at these sizes) so a solver can run while
+        further host mutations accumulate.
+        """
+        m = self.m_cap
+        excess = self.excess.copy()
+        cap = self.cap[:m].astype(np.int32).copy()
+        low = self.low[:m]
+        cost = self.cost[:m].copy()
+        src = self.src[:m].copy()
+        dst = self.dst[:m].copy()
+        flow_offset = low.astype(np.int32).copy()
+        has_low = low > 0
+        if has_low.any():
+            idx = np.nonzero(has_low)[0]
+            np.subtract.at(excess, src[idx], low[idx].astype(np.int64))
+            np.add.at(excess, dst[idx], low[idx].astype(np.int64))
+            cap[idx] -= low[idx]
+        return FlowProblem(
+            num_nodes=self.n_cap,
+            excess=excess,
+            node_type=self.node_type.copy(),
+            src=src,
+            dst=dst,
+            cap=cap,
+            cost=cost,
+            flow_offset=flow_offset,
+            num_arcs=self._num_slots,
+        )

@@ -1,0 +1,108 @@
+"""DIMACS text codec.
+
+Reference: scheduling/flow/dimacs/{doc.go,export.go,add_node_change.go,
+create_arc_change.go,update_arc_change.go,remove_node_change.go}. In the
+reference this text stream over pipes IS the solver wire protocol; in the
+TPU build the solver consumes flat arrays (graph/device_export.py), so
+this codec exists for debugging, golden-file tests, and interop with
+external DIMACS tooling.
+
+Format (reference: dimacs/doc.go:3-22):
+    c <comment>
+    p min <num nodes> <num arcs>
+    n <id> <excess> [<solver node type>]
+    a <src> <dst> <cap lower> <cap upper> <cost> [<arc type>]
+Incremental lines additionally use
+    r <id>                                      (remove node)
+    x <src> <dst> <low> <cap> <cost> <type> <old cost>   (update arc)
+and each batch ends with "c EOI" (end of iteration).
+"""
+
+from __future__ import annotations
+
+from typing import IO, Iterable, List
+
+from .changes import AddNodeChange, Change, ChangeArcChange, NewArcChange, RemoveNodeChange
+from .flowgraph import FlowGraph, NodeType
+
+# Solver-side node taxonomy (reference: dimacs/export.go:53-70 and
+# add_node_change.go:27-36; the ordering is ABI with the solver there).
+SOLVER_NODE_OTHER = 0
+SOLVER_NODE_TASK = 1
+SOLVER_NODE_PU = 2
+SOLVER_NODE_SINK = 3
+SOLVER_NODE_MACHINE = 4
+SOLVER_NODE_INTERMEDIATE_RES = 5
+
+_SOLVER_TYPE = {
+    NodeType.UNSCHEDULED_TASK: SOLVER_NODE_TASK,
+    NodeType.SCHEDULED_TASK: SOLVER_NODE_TASK,
+    NodeType.ROOT_TASK: SOLVER_NODE_TASK,
+    NodeType.PU: SOLVER_NODE_PU,
+    NodeType.SINK: SOLVER_NODE_SINK,
+    NodeType.MACHINE: SOLVER_NODE_MACHINE,
+    NodeType.NUMA: SOLVER_NODE_INTERMEDIATE_RES,
+    NodeType.SOCKET: SOLVER_NODE_INTERMEDIATE_RES,
+    NodeType.CACHE: SOLVER_NODE_INTERMEDIATE_RES,
+    NodeType.CORE: SOLVER_NODE_INTERMEDIATE_RES,
+}
+
+
+def solver_node_type(node_type: NodeType) -> int:
+    return _SOLVER_TYPE.get(node_type, SOLVER_NODE_OTHER)
+
+
+def export(graph: FlowGraph, out: IO[str], with_node_types: bool = True) -> None:
+    """Full-graph export (reference: dimacs/export.go:11-29)."""
+    out.write(f"p min {graph.num_nodes} {graph.num_arcs}\n")
+    for node in graph.nodes():
+        if with_node_types:
+            out.write(f"n {node.id} {node.excess} {solver_node_type(node.type)}\n")
+        else:
+            out.write(f"n {node.id} {node.excess}\n")
+    for arc in graph.arcs():
+        out.write(f"a {arc.src} {arc.dst} {arc.cap_lower} {arc.cap_upper} {arc.cost}\n")
+    out.write("c EOI\n")
+    out.flush()
+
+
+def export_incremental(changes: Iterable[Change], out: IO[str]) -> None:
+    """Incremental delta export (reference: dimacs/export.go:31-49)."""
+    for ch in changes:
+        if isinstance(ch, AddNodeChange):
+            out.write(f"n {ch.node_id} {ch.excess} {solver_node_type(ch.node_type)}\n")
+        elif isinstance(ch, RemoveNodeChange):
+            out.write(f"r {ch.node_id}\n")
+        elif isinstance(ch, NewArcChange):
+            out.write(
+                f"a {ch.src} {ch.dst} {ch.cap_lower} {ch.cap_upper} {ch.cost} {int(ch.arc_type)}\n"
+            )
+        elif isinstance(ch, ChangeArcChange):
+            out.write(
+                f"x {ch.src} {ch.dst} {ch.cap_lower} {ch.cap_upper} {ch.cost} "
+                f"{int(ch.arc_type)} {ch.old_cost}\n"
+            )
+        else:  # pragma: no cover - exhaustive over Change union
+            raise TypeError(f"unknown change record: {ch!r}")
+    out.write("c EOI\n")
+    out.flush()
+
+
+def parse_graph(lines: Iterable[str]):
+    """Parse a full-graph DIMACS export into (num_nodes, node_lines, arc_lines)
+    tuples of ints, for golden-file tests."""
+    nodes: List[tuple] = []
+    arcs: List[tuple] = []
+    header = None
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("c"):
+            continue
+        parts = line.split()
+        if parts[0] == "p":
+            header = (int(parts[2]), int(parts[3]))
+        elif parts[0] == "n":
+            nodes.append(tuple(int(x) for x in parts[1:]))
+        elif parts[0] == "a":
+            arcs.append(tuple(int(x) for x in parts[1:]))
+    return header, nodes, arcs

@@ -1,0 +1,784 @@
+"""L5: the graph manager — job/task/resource lifecycle → graph mutations.
+
+Reference: scheduling/flow/flowmanager/graph_manager.go (the heart of the
+system, 1338 lines). Behavior parity notes:
+
+- every mutation goes through the journaled ChangeManager (the invariant
+  that makes incremental solving possible, SURVEY §3.5);
+- task nodes carry supply 1 and the sink absorbs it (addTaskNode
+  graph_manager.go:632-648, removeTaskNode :803-813);
+- each job gets an unscheduled-aggregator escape node so infeasibility is
+  impossible (updateUnscheduledAggNode :1287-1305);
+- the preemption flag flips both the capacity rule on resource arcs
+  (:662-667) and scheduled-task arc handling (pin vs keep, :675-720,
+  :855-888);
+- AddOrUpdateJobNodes drives a worklist BFS (updateFlowGraph :1012-1033)
+  that touches task, EC, and resource nodes exactly once per round.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+from ..costmodels.base import CostModeler
+from ..data import (
+    DeltaType,
+    JobDescriptor,
+    ResourceDescriptor,
+    ResourceTopologyNodeDescriptor,
+    ResourceType,
+    SchedulingDelta,
+    TaskDescriptor,
+    TaskState,
+)
+from ..utils import ResourceMap, job_id_from_string, resource_id_from_string
+from .changes import ChangeManager, ChangeStats, ChangeType
+from .flowgraph import Arc, ArcType, Node, NodeType, resource_node_type
+
+TaskMapping = Dict[int, int]  # task node id -> PU node id (flowmanager/types.go:6)
+
+
+def task_needs_node(td: TaskDescriptor) -> bool:
+    """Reference: graph_manager.go:1333-1338."""
+    return td.state in (TaskState.RUNNABLE, TaskState.RUNNING, TaskState.ASSIGNED)
+
+
+class GraphManager:
+    def __init__(
+        self,
+        cost_model: CostModeler,
+        leaf_resource_ids: Set[int],
+        stats: Optional[ChangeStats] = None,
+        max_tasks_per_pu: int = 1,
+        preemption: bool = False,
+        update_preferences_running_task: bool = False,
+    ) -> None:
+        self.preemption = preemption
+        self.update_preferences_running_task = update_preferences_running_task
+        self.max_tasks_per_pu = max_tasks_per_pu
+        self.cm = ChangeManager(stats)
+        self.cost_model = cost_model
+        self.sink_node = self.cm.add_node(NodeType.SINK, 0, ChangeType.ADD_SINK_NODE, "SINK")
+
+        self.resource_to_node: Dict[int, Node] = {}
+        self.task_to_node: Dict[int, Node] = {}
+        self.task_ec_to_node: Dict[int, Node] = {}
+        self.job_unsched_to_node: Dict[int, Node] = {}
+        self.task_to_running_arc: Dict[int, Arc] = {}
+        self.node_to_parent_node: Dict[int, Node] = {}  # keyed by node id
+        self.leaf_resource_ids = leaf_resource_ids  # shared with the cost model
+        self.leaf_node_ids: Set[int] = set()
+        self._cur_traversal_counter = 0
+
+    # ------------------------------------------------------------------
+    # Public lifecycle API (reference interface graph_manager.go:32-86)
+    # ------------------------------------------------------------------
+
+    def add_or_update_job_nodes(self, jobs: List[JobDescriptor]) -> None:
+        """Reference: graph_manager.go:166-208."""
+        node_queue: Deque[Tuple[Optional[Node], TaskDescriptor]] = deque()
+        marked: Set[int] = set()
+        for job in jobs:
+            jid = job_id_from_string(job.uuid)
+            if jid not in self.job_unsched_to_node:
+                self._add_unscheduled_agg_node(jid)
+            root_td = job.root_task
+            assert root_td is not None, f"job {job.uuid} has no root task"
+            root_node = self.task_to_node.get(root_td.uid)
+            if root_node is not None:
+                node_queue.append((root_node, root_td))
+                marked.add(root_node.id)
+                continue
+            if task_needs_node(root_td):
+                root_node = self._add_task_node(jid, root_td)
+                self._update_unscheduled_agg_node(self.job_unsched_to_node[jid], 1)
+                node_queue.append((root_node, root_td))
+                marked.add(root_node.id)
+            else:
+                # No node yet; still traverse for schedulable children.
+                node_queue.append((None, root_td))
+        self._update_flow_graph(node_queue, marked)
+
+    def update_time_dependent_costs(self, jobs: List[JobDescriptor]) -> None:
+        self.add_or_update_job_nodes(jobs)
+
+    def add_resource_topology(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
+        """Reference: graph_manager.go:238-251."""
+        rd = rtnd.resource_desc
+        self._add_resource_topology_dfs(rtnd)
+        if rtnd.parent_id:
+            curr = self.resource_to_node[resource_id_from_string(rtnd.parent_id)]
+            self._update_resource_stats_up_to_root(
+                curr,
+                self._capacity_to_parent(rd),
+                rd.num_slots_below,
+                rd.num_running_tasks_below,
+            )
+
+    def update_resource_topology(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
+        """Reference: graph_manager.go:217-236."""
+        rd = rtnd.resource_desc
+        old_capacity = self._capacity_to_parent(rd)
+        old_slots = rd.num_slots_below
+        old_running = rd.num_running_tasks_below
+        self._update_resource_topology_dfs(rtnd)
+        if rtnd.parent_id:
+            curr = self.resource_to_node[resource_id_from_string(rtnd.parent_id)]
+            self._update_resource_stats_up_to_root(
+                curr,
+                self._capacity_to_parent(rd) - old_capacity,
+                rd.num_slots_below - old_slots,
+                rd.num_running_tasks_below - old_running,
+            )
+
+    def remove_resource_topology(self, rd: ResourceDescriptor) -> List[int]:
+        """Reference: graph_manager.go:362-387. Returns removed PU node ids."""
+        r_node = self.resource_to_node.get(resource_id_from_string(rd.uuid))
+        if r_node is None:
+            raise KeyError(f"no node for resource {rd.uuid}")
+        removed_pus: List[int] = []
+        cap_delta = 0
+        for arc in list(r_node.outgoing.values()):
+            cap_delta -= arc.cap_upper
+            if arc.dst_node.resource_id != 0:
+                removed_pus.extend(self._traverse_and_remove_topology(arc.dst_node))
+        self._update_resource_stats_up_to_root(
+            r_node,
+            cap_delta,
+            -r_node.resource_descriptor.num_slots_below,
+            -r_node.resource_descriptor.num_running_tasks_below,
+        )
+        if r_node.type == NodeType.PU:
+            removed_pus.append(r_node.id)
+        elif r_node.type == NodeType.MACHINE:
+            self.cost_model.remove_machine(r_node.resource_id)
+        self._remove_resource_node(r_node)
+        return removed_pus
+
+    def job_completed(self, job_id: int) -> None:
+        """Reference: graph_manager.go:341-345."""
+        node = self.job_unsched_to_node.pop(job_id)
+        self.cm.delete_node(node, ChangeType.DEL_UNSCHED_JOB_NODE, "JobCompleted")
+
+    def purge_unconnected_equiv_class_nodes(self) -> None:
+        """Reference: graph_manager.go:347-357."""
+        for node in list(self.task_ec_to_node.values()):
+            if not node.incoming:
+                self._remove_equiv_class_node(node)
+
+    def task_completed(self, task_id: int) -> int:
+        """Reference: graph_manager.go:389-405."""
+        task_node = self.task_to_node[task_id]
+        if self.preemption:
+            self._update_unscheduled_agg_node(self.job_unsched_to_node[task_node.job_id], -1)
+        self.task_to_running_arc.pop(task_id, None)
+        return self._remove_task_node(task_node)
+        # The task stays in the cost model: final-report handling still
+        # needs its equivalence classes (reference note at :402-404).
+
+    def task_evicted(self, task_id: int, resource_id: int) -> None:
+        """Reference: graph_manager.go:412-433."""
+        task_node = self.task_to_node[task_id]
+        task_node.type = NodeType.UNSCHEDULED_TASK
+        arc = self.task_to_running_arc.pop(task_id)
+        self.cm.delete_arc(arc, ChangeType.DEL_ARC_EVICTED_TASK, "TaskEvicted: delete running arc")
+        if not self.preemption:
+            jid = job_id_from_string(task_node.task.job_id)
+            self._update_unscheduled_agg_node(self.job_unsched_to_node[jid], 1)
+
+    def task_failed(self, task_id: int) -> None:
+        """Reference: graph_manager.go:435-448."""
+        task_node = self.task_to_node[task_id]
+        if self.preemption:
+            self._update_unscheduled_agg_node(self.job_unsched_to_node[task_node.job_id], -1)
+        self.task_to_running_arc.pop(task_id, None)
+        self._remove_task_node(task_node)
+        self.cost_model.remove_task(task_id)
+
+    def task_killed(self, task_id: int) -> None:
+        self.task_failed(task_id)
+
+    def task_migrated(self, task_id: int, from_rid: int, to_rid: int) -> None:
+        self.task_evicted(task_id, from_rid)
+        self.task_scheduled(task_id, to_rid)
+
+    def task_scheduled(self, task_id: int, resource_id: int) -> None:
+        """Reference: graph_manager.go:454-460."""
+        task_node = self.task_to_node[task_id]
+        task_node.type = NodeType.SCHEDULED_TASK
+        res_node = self.resource_to_node[resource_id]
+        self._update_arcs_for_scheduled_task(task_node, res_node)
+
+    def update_all_costs_to_unscheduled_aggs(self) -> None:
+        """Reference: graph_manager.go:462-475."""
+        for job_node in self.job_unsched_to_node.values():
+            for arc in list(job_node.incoming.values()):
+                if arc.src_node.is_task_assigned_or_running:
+                    self._update_running_task_node(arc.src_node, False, None, None)
+                else:
+                    self._update_task_to_unscheduled_agg_arc(arc.src_node)
+
+    def compute_topology_statistics(self, start: Node) -> None:
+        """Reverse BFS from the sink, gathering usage statistics; correct
+        only for tree topologies (reference: graph_manager.go:478-511)."""
+        self._cur_traversal_counter += 1
+        counter = self._cur_traversal_counter
+        to_visit: Deque[Node] = deque([start])
+        start.visited = counter
+        while to_visit:
+            cur = to_visit.popleft()
+            for arc in cur.incoming.values():
+                src = arc.src_node
+                if src.visited != counter:
+                    self.cost_model.prepare_stats(src)
+                    to_visit.append(src)
+                    src.visited = counter
+                self.cost_model.gather_stats(src, cur)
+                self.cost_model.update_stats(src, cur)
+
+    # ------------------------------------------------------------------
+    # Delta generation (reference: graph_manager.go:253-339)
+    # ------------------------------------------------------------------
+
+    def node_binding_to_scheduling_delta(
+        self, task_node_id: int, res_node_id: int, task_bindings: Dict[int, int]
+    ) -> Optional[SchedulingDelta]:
+        task_node = self.cm.graph.node(task_node_id)
+        assert task_node is not None and task_node.is_task_node, f"non-task node {task_node_id}"
+        res_node = self.cm.graph.node(res_node_id)
+        assert res_node is not None and res_node.type == NodeType.PU, f"non-PU node {res_node_id}"
+        task = task_node.task
+        rd = res_node.resource_descriptor
+        bound = task_bindings.get(task.uid)
+        if bound is None:
+            return SchedulingDelta(DeltaType.PLACE, task.uid, rd.uuid)
+        if bound != resource_id_from_string(rd.uuid):
+            return SchedulingDelta(DeltaType.MIGRATE, task.uid, rd.uuid)
+        # Already scheduled here; repopulate the running-task list that
+        # SchedulingDeltasForPreemptedTasks cleared.
+        rd.current_running_tasks.append(task.uid)
+        return None
+
+    def scheduling_deltas_for_preempted_tasks(
+        self, task_mapping: TaskMapping, resource_map: ResourceMap
+    ) -> List[SchedulingDelta]:
+        deltas: List[SchedulingDelta] = []
+        for rs in resource_map.unsafe_get().values():
+            rd = rs.descriptor
+            for task_id in rd.current_running_tasks:
+                task_node = self.task_to_node.get(task_id)
+                if task_node is None:
+                    continue  # task finished; no PREEMPT needed
+                if task_node.id not in task_mapping:
+                    deltas.append(SchedulingDelta(DeltaType.PREEMPT, task_id, rd.uuid))
+            # Cleared wholesale; NodeBindingToSchedulingDelta repopulates
+            # (reference: graph_manager.go:327-337).
+            rd.current_running_tasks = []
+        return deltas
+
+    # ------------------------------------------------------------------
+    # Private: node add/remove helpers
+    # ------------------------------------------------------------------
+
+    def _add_equiv_class_node(self, ec: int) -> Node:
+        node = self.cm.add_node(NodeType.EQUIV_CLASS, 0, ChangeType.ADD_EQUIV_CLASS_NODE, f"EC_{ec}")
+        node.equiv_class = ec
+        assert ec not in self.task_ec_to_node
+        self.task_ec_to_node[ec] = node
+        return node
+
+    def _add_resource_node(self, rd: ResourceDescriptor) -> Node:
+        comment = rd.friendly_name or "AddResourceNode"
+        node = self.cm.add_node(resource_node_type(rd), 0, ChangeType.ADD_RESOURCE_NODE, comment)
+        rid = resource_id_from_string(rd.uuid)
+        node.resource_id = rid
+        node.resource_descriptor = rd
+        assert rid not in self.resource_to_node
+        self.resource_to_node[rid] = node
+        if node.type == NodeType.PU:
+            self.leaf_node_ids.add(node.id)
+            self.leaf_resource_ids.add(rid)
+        return node
+
+    def _add_task_node(self, job_id: int, td: TaskDescriptor) -> Node:
+        self.cost_model.add_task(td.uid)
+        node = self.cm.add_node(NodeType.UNSCHEDULED_TASK, 1, ChangeType.ADD_TASK_NODE, td.name or "AddTaskNode")
+        node.task = td
+        node.job_id = job_id
+        self.sink_node.excess -= 1
+        assert td.uid not in self.task_to_node
+        self.task_to_node[td.uid] = node
+        return node
+
+    def _add_unscheduled_agg_node(self, job_id: int) -> Node:
+        node = self.cm.add_node(
+            NodeType.JOB_AGGREGATOR, 0, ChangeType.ADD_UNSCHED_JOB_NODE, f"UNSCHED_AGG_for_{job_id}"
+        )
+        node.job_id = job_id
+        assert job_id not in self.job_unsched_to_node
+        self.job_unsched_to_node[job_id] = node
+        return node
+
+    def _remove_equiv_class_node(self, node: Node) -> None:
+        del self.task_ec_to_node[node.equiv_class]
+        self.cm.delete_node(node, ChangeType.DEL_EQUIV_CLASS_NODE, "RemoveEquivClassNode")
+
+    def _remove_resource_node(self, node: Node) -> None:
+        self.node_to_parent_node.pop(node.id, None)
+        self.leaf_node_ids.discard(node.id)
+        self.leaf_resource_ids.discard(node.resource_id)
+        self.resource_to_node.pop(node.resource_id, None)
+        self.cm.delete_node(node, ChangeType.DEL_RESOURCE_NODE, "RemoveResourceNode")
+
+    def _remove_task_node(self, node: Node) -> int:
+        node_id = node.id
+        node.excess = 0
+        self.sink_node.excess += 1
+        del self.task_to_node[node.task.uid]
+        self.cm.delete_node(node, ChangeType.DEL_TASK_NODE, "RemoveTaskNode")
+        return node_id
+
+    # ------------------------------------------------------------------
+    # Private: resource topology
+    # ------------------------------------------------------------------
+
+    def _capacity_to_parent(self, rd: ResourceDescriptor) -> int:
+        """Reference: graph_manager.go:662-667 — slots below, minus running
+        tasks below when preemption is off (a running task's slot must not
+        be handed out again if it cannot be preempted)."""
+        if self.preemption:
+            return rd.num_slots_below
+        return rd.num_slots_below - rd.num_running_tasks_below
+
+    def _add_resource_topology_dfs(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
+        """Reference: graph_manager.go:557-630."""
+        rd = rtnd.resource_desc
+        rid = resource_id_from_string(rd.uuid)
+        node = self.resource_to_node.get(rid)
+        added_new = False
+        if node is None:
+            added_new = True
+            node = self._add_resource_node(rd)
+            if node.type == NodeType.PU:
+                self._update_res_to_sink_arc(node)
+                if rd.num_slots_below == 0:
+                    rd.num_slots_below = self.max_tasks_per_pu
+                    if rd.num_running_tasks_below == 0:
+                        rd.num_running_tasks_below = len(rd.current_running_tasks)
+            else:
+                if node.type == NodeType.MACHINE:
+                    self.cost_model.add_machine(rtnd)
+                rd.num_slots_below = 0
+                rd.num_running_tasks_below = 0
+        else:
+            rd.num_slots_below = 0
+            rd.num_running_tasks_below = 0
+
+        for child in rtnd.children:
+            self._add_resource_topology_dfs(child)
+            rd.num_slots_below += child.resource_desc.num_slots_below
+            rd.num_running_tasks_below += child.resource_desc.num_running_tasks_below
+
+        if not rtnd.parent_id:
+            if rd.type != ResourceType.COORDINATOR:
+                raise ValueError("a non-coordinator resource must have a parent")
+            return
+        if added_new:
+            parent = self.resource_to_node[resource_id_from_string(rtnd.parent_id)]
+            assert node.id not in self.node_to_parent_node
+            self.node_to_parent_node[node.id] = parent
+            self.cm.add_arc(
+                parent,
+                node,
+                0,
+                self._capacity_to_parent(rd),
+                self.cost_model.resource_node_to_resource_node_cost(parent.resource_descriptor, rd),
+                ArcType.OTHER,
+                ChangeType.ADD_ARC_BETWEEN_RES,
+                "AddResourceTopologyDFS",
+            )
+
+    def _update_resource_topology_dfs(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
+        """Reference: graph_manager.go:1063-1092."""
+        rd = rtnd.resource_desc
+        rd.num_slots_below = 0
+        rd.num_running_tasks_below = 0
+        if rd.type == ResourceType.PU:
+            rd.num_slots_below = self.max_tasks_per_pu
+            rd.num_running_tasks_below = len(rd.current_running_tasks)
+        for child in rtnd.children:
+            self._update_resource_topology_dfs(child)
+            rd.num_slots_below += child.resource_desc.num_slots_below
+            rd.num_running_tasks_below += child.resource_desc.num_running_tasks_below
+        if rtnd.parent_id:
+            curr = self.resource_to_node[resource_id_from_string(rd.uuid)]
+            parent = self.node_to_parent_node[curr.id]
+            parent_arc = self.cm.graph.get_arc(parent, curr)
+            self.cm.change_arc_capacity(
+                parent_arc, self._capacity_to_parent(rd), ChangeType.CHG_ARC_BETWEEN_RES, "UpdateResourceTopologyDFS"
+            )
+
+    def _update_resource_stats_up_to_root(
+        self, curr: Node, cap_delta: int, slots_delta: int, running_delta: int
+    ) -> None:
+        """Reference: graph_manager.go:1041-1061."""
+        while True:
+            parent = self.node_to_parent_node.get(curr.id)
+            if parent is None:
+                return
+            parent_arc = self.cm.graph.get_arc(parent, curr)
+            assert parent_arc is not None, f"missing arc {parent.id}->{curr.id}"
+            self.cm.change_arc_capacity(
+                parent_arc, parent_arc.cap_upper + cap_delta, ChangeType.CHG_ARC_BETWEEN_RES, "UpdateCapacityUpToRoot"
+            )
+            prd = parent.resource_descriptor
+            prd.num_slots_below += slots_delta
+            prd.num_running_tasks_below += running_delta
+            curr = parent
+
+    def _traverse_and_remove_topology(self, node: Node) -> List[int]:
+        """Reference: graph_manager.go:829-844."""
+        removed: List[int] = []
+        for arc in list(node.outgoing.values()):
+            if arc.dst_node.resource_id != 0:
+                removed.extend(self._traverse_and_remove_topology(arc.dst_node))
+        if node.type == NodeType.PU:
+            removed.append(node.id)
+        elif node.type == NodeType.MACHINE:
+            self.cost_model.remove_machine(node.resource_id)
+        self._remove_resource_node(node)
+        return removed
+
+    # ------------------------------------------------------------------
+    # Private: worklist update (the per-round hot path)
+    # ------------------------------------------------------------------
+
+    def _update_flow_graph(
+        self, node_queue: Deque[Tuple[Optional[Node], TaskDescriptor]], marked: Set[int]
+    ) -> None:
+        """Reference: graph_manager.go:1012-1033."""
+        while node_queue:
+            node, task = node_queue.popleft()
+            if node is None:
+                self._update_children_tasks(task, node_queue, marked)
+            elif node.is_task_node:
+                self._update_task_node(node, node_queue, marked)
+                self._update_children_tasks(task, node_queue, marked)
+            elif node.is_equiv_class_node:
+                self._update_equiv_class_node(node, node_queue, marked)
+            elif node.is_resource_node:
+                self._update_res_outgoing_arcs(node, node_queue, marked)
+            else:
+                raise ValueError(f"unexpected node type in worklist: {node.type}")
+
+    def _update_children_tasks(
+        self, td: TaskDescriptor, node_queue: Deque, marked: Set[int]
+    ) -> None:
+        """Reference: graph_manager.go:895-929."""
+        for child in td.spawned:
+            child_node = self.task_to_node.get(child.uid)
+            if child_node is not None:
+                if child_node.id not in marked:
+                    node_queue.append((child_node, child))
+                    marked.add(child_node.id)
+                continue
+            if not task_needs_node(child):
+                node_queue.append((None, child))
+                continue
+            jid = job_id_from_string(child.job_id)
+            child_node = self._add_task_node(jid, child)
+            self._update_unscheduled_agg_node(self.job_unsched_to_node[jid], 1)
+            node_queue.append((child_node, child))
+            marked.add(child_node.id)
+
+    def _update_task_node(self, task_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        """Reference: graph_manager.go:1183-1192."""
+        if task_node.is_task_assigned_or_running:
+            self._update_running_task_node(
+                task_node, self.update_preferences_running_task, node_queue, marked
+            )
+            return
+        self._update_task_to_unscheduled_agg_arc(task_node)
+        self._update_task_to_equiv_arcs(task_node, node_queue, marked)
+        self._update_task_to_res_arcs(task_node, node_queue, marked)
+
+    def _update_equiv_class_node(self, ec_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        self._update_equiv_to_equiv_arcs(ec_node, node_queue, marked)
+        self._update_equiv_to_res_arcs(ec_node, node_queue, marked)
+
+    def _update_equiv_to_equiv_arcs(self, ec_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        """Reference: graph_manager.go:939-970."""
+        pref_ecs = self.cost_model.get_equiv_class_to_equiv_classes_arcs(ec_node.equiv_class)
+        if not pref_ecs:
+            self._remove_invalid_ec_pref_arcs(ec_node, pref_ecs, ChangeType.DEL_ARC_BETWEEN_EQUIV_CLASS)
+            return
+        for pref_ec in pref_ecs:
+            pref_node = self.task_ec_to_node.get(pref_ec)
+            if pref_node is None:
+                pref_node = self._add_equiv_class_node(pref_ec)
+            cost, cap_upper = self.cost_model.equiv_class_to_equiv_class(ec_node.equiv_class, pref_ec)
+            arc = self.cm.graph.get_arc(ec_node, pref_node)
+            if arc is None:
+                self.cm.add_arc(
+                    ec_node, pref_node, 0, cap_upper, cost, ArcType.OTHER,
+                    ChangeType.ADD_ARC_BETWEEN_EQUIV_CLASS, "UpdateEquivClassNode",
+                )
+            else:
+                self.cm.change_arc(
+                    arc, arc.cap_lower, cap_upper, cost,
+                    ChangeType.CHG_ARC_BETWEEN_EQUIV_CLASS, "UpdateEquivClassNode",
+                )
+            if pref_node.id not in marked:
+                marked.add(pref_node.id)
+                node_queue.append((pref_node, pref_node.task))
+        self._remove_invalid_ec_pref_arcs(ec_node, pref_ecs, ChangeType.DEL_ARC_BETWEEN_EQUIV_CLASS)
+
+    def _update_equiv_to_res_arcs(self, ec_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        """Reference: graph_manager.go:974-1010, vectorized through the
+        batch cost-model hook so wide fan-outs (EC → every machine) cost
+        one call."""
+        pref_rids = self.cost_model.get_outgoing_equiv_class_pref_arcs(ec_node.equiv_class)
+        if not pref_rids:
+            self._remove_invalid_pref_res_arcs(ec_node, pref_rids, ChangeType.DEL_ARC_EQUIV_CLASS_TO_RES)
+            return
+        costs, caps = self.cost_model.ec_to_resource_batch(ec_node.equiv_class, pref_rids)
+        for pref_rid, cost, cap_upper in zip(pref_rids, costs, caps):
+            pref_node = self.resource_to_node.get(pref_rid)
+            assert pref_node is not None, "cost model preferred an unknown resource"
+            arc = self.cm.graph.get_arc(ec_node, pref_node)
+            if arc is None:
+                self.cm.add_arc(
+                    ec_node, pref_node, 0, cap_upper, cost, ArcType.OTHER,
+                    ChangeType.ADD_ARC_EQUIV_CLASS_TO_RES, "UpdateEquivToResArcs",
+                )
+            else:
+                self.cm.change_arc(
+                    arc, arc.cap_lower, cap_upper, cost,
+                    ChangeType.CHG_ARC_EQUIV_CLASS_TO_RES, "UpdateEquivToResArcs",
+                )
+            if pref_node.id not in marked:
+                marked.add(pref_node.id)
+                node_queue.append((pref_node, pref_node.task))
+        self._remove_invalid_pref_res_arcs(ec_node, pref_rids, ChangeType.DEL_ARC_EQUIV_CLASS_TO_RES)
+
+    def _update_res_outgoing_arcs(self, res_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        """Reference: graph_manager.go:1094-1111."""
+        for arc in list(res_node.outgoing.values()):
+            if arc.dst_node.resource_id == 0:
+                self._update_res_to_sink_arc(res_node)
+                continue
+            cost = self.cost_model.resource_node_to_resource_node_cost(
+                res_node.resource_descriptor, arc.dst_node.resource_descriptor
+            )
+            self.cm.change_arc_cost(arc, cost, ChangeType.CHG_ARC_BETWEEN_RES, "UpdateResOutgoingArcs")
+            if arc.dst_node.id not in marked:
+                marked.add(arc.dst_node.id)
+                node_queue.append((arc.dst_node, arc.dst_node.task))
+
+    def _update_res_to_sink_arc(self, res_node: Node) -> None:
+        """Reference: graph_manager.go:1116-1129."""
+        if res_node.type != NodeType.PU:
+            raise ValueError("only PU nodes connect to the sink")
+        arc = self.cm.graph.get_arc(res_node, self.sink_node)
+        cost = self.cost_model.leaf_resource_node_to_sink_cost(res_node.resource_id)
+        if arc is None:
+            self.cm.add_arc(
+                res_node, self.sink_node, 0, self.max_tasks_per_pu, cost, ArcType.OTHER,
+                ChangeType.ADD_ARC_RES_TO_SINK, "UpdateResToSinkArc",
+            )
+        else:
+            self.cm.change_arc_cost(arc, cost, ChangeType.CHG_ARC_RES_TO_SINK, "UpdateResToSinkArc")
+
+    # -- task arcs ---------------------------------------------------------
+
+    def _update_running_task_node(
+        self,
+        task_node: Node,
+        update_preferences: bool,
+        node_queue: Optional[Deque],
+        marked: Optional[Set[int]],
+    ) -> None:
+        """Reference: graph_manager.go:1140-1158."""
+        task_id = task_node.task.uid
+        running_arc = self.task_to_running_arc.get(task_id)
+        assert running_arc is not None, f"no running arc for task {task_id}"
+        new_cost = self.cost_model.task_continuation_cost(task_id)
+        self.cm.change_arc_cost(
+            running_arc, new_cost, ChangeType.CHG_ARC_RUNNING_TASK, "UpdateRunningTaskNode: continuation cost"
+        )
+        if not self.preemption:
+            return
+        self._update_running_task_to_unscheduled_agg_arc(task_node)
+        if update_preferences:
+            self._update_task_to_res_arcs(task_node, node_queue, marked)
+            self._update_task_to_equiv_arcs(task_node, node_queue, marked)
+
+    def _update_running_task_to_unscheduled_agg_arc(self, task_node: Node) -> None:
+        """Reference: graph_manager.go:1164-1181 (preemption-only)."""
+        assert self.preemption, "running task has no unsched arc without preemption"
+        unsched = self.job_unsched_to_node[task_node.job_id]
+        arc = self.cm.graph.get_arc(task_node, unsched)
+        assert arc is not None, "running task must keep its unsched arc under preemption"
+        cost = self.cost_model.task_preemption_cost(task_node.task.uid)
+        self.cm.change_arc_cost(arc, cost, ChangeType.CHG_ARC_TO_UNSCHED, "UpdateRunningTaskToUnscheduledAggArc")
+
+    def _update_task_to_equiv_arcs(self, task_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        """Reference: graph_manager.go:1197-1226."""
+        pref_ecs = self.cost_model.get_task_equiv_classes(task_node.task.uid)
+        if not pref_ecs:
+            self._remove_invalid_ec_pref_arcs(task_node, pref_ecs, ChangeType.DEL_ARC_TASK_TO_EQUIV_CLASS)
+            return
+        for pref_ec in pref_ecs:
+            pref_node = self.task_ec_to_node.get(pref_ec)
+            if pref_node is None:
+                pref_node = self._add_equiv_class_node(pref_ec)
+            cost = self.cost_model.task_to_equiv_class_aggregator(task_node.task.uid, pref_ec)
+            arc = self.cm.graph.get_arc(task_node, pref_node)
+            if arc is None:
+                self.cm.add_arc(
+                    task_node, pref_node, 0, 1, cost, ArcType.OTHER,
+                    ChangeType.ADD_ARC_TASK_TO_EQUIV_CLASS, "UpdateTaskToEquivArcs",
+                )
+            else:
+                self.cm.change_arc(
+                    arc, arc.cap_lower, arc.cap_upper, cost,
+                    ChangeType.CHG_ARC_TASK_TO_EQUIV_CLASS, "UpdateTaskToEquivArcs",
+                )
+            if pref_node.id not in marked:
+                marked.add(pref_node.id)
+                node_queue.append((pref_node, pref_node.task))
+        self._remove_invalid_ec_pref_arcs(task_node, pref_ecs, ChangeType.DEL_ARC_TASK_TO_EQUIV_CLASS)
+
+    def _update_task_to_res_arcs(self, task_node: Node, node_queue: Deque, marked: Set[int]) -> None:
+        """Reference: graph_manager.go:1229-1264."""
+        pref_rids = self.cost_model.get_task_preference_arcs(task_node.task.uid)
+        if not pref_rids:
+            self._remove_invalid_pref_res_arcs(task_node, pref_rids, ChangeType.DEL_ARC_TASK_TO_RES)
+            return
+        for pref_rid in pref_rids:
+            pref_node = self.resource_to_node.get(pref_rid)
+            assert pref_node is not None, "cost model preferred an unknown resource"
+            cost = self.cost_model.task_to_resource_node_cost(task_node.task.uid, pref_rid)
+            arc = self.cm.graph.get_arc(task_node, pref_node)
+            if arc is None:
+                self.cm.add_arc(
+                    task_node, pref_node, 0, 1, cost, ArcType.OTHER,
+                    ChangeType.ADD_ARC_TASK_TO_RES, "UpdateTaskToResArcs",
+                )
+            elif arc.type != ArcType.RUNNING:
+                # Running arcs are priced by TaskContinuationCost elsewhere.
+                self.cm.change_arc_cost(arc, cost, ChangeType.CHG_ARC_TASK_TO_RES, "UpdateTaskToResArcs")
+            if pref_node.id not in marked:
+                marked.add(pref_node.id)
+                node_queue.append((pref_node, pref_node.task))
+        self._remove_invalid_pref_res_arcs(task_node, pref_rids, ChangeType.DEL_ARC_TASK_TO_RES)
+
+    def _update_task_to_unscheduled_agg_arc(self, task_node: Node) -> Node:
+        """Reference: graph_manager.go:1270-1285."""
+        unsched = self.job_unsched_to_node.get(task_node.job_id)
+        if unsched is None:
+            unsched = self._add_unscheduled_agg_node(task_node.job_id)
+        cost = self.cost_model.task_to_unscheduled_agg_cost(task_node.task.uid)
+        arc = self.cm.graph.get_arc(task_node, unsched)
+        if arc is None:
+            self.cm.add_arc(
+                task_node, unsched, 0, 1, cost, ArcType.OTHER,
+                ChangeType.ADD_ARC_TO_UNSCHED, "UpdateTaskToUnscheduledAggArc",
+            )
+        else:
+            self.cm.change_arc_cost(arc, cost, ChangeType.CHG_ARC_TO_UNSCHED, "UpdateTaskToUnscheduledAggArc")
+        return unsched
+
+    def _update_unscheduled_agg_node(self, unsched: Node, cap_delta: int) -> None:
+        """Reference: graph_manager.go:1291-1305."""
+        arc = self.cm.graph.get_arc(unsched, self.sink_node)
+        cost = self.cost_model.unscheduled_agg_to_sink_cost(unsched.job_id)
+        if arc is not None:
+            self.cm.change_arc(
+                arc, arc.cap_lower, arc.cap_upper + cap_delta, cost,
+                ChangeType.CHG_ARC_FROM_UNSCHED, "UpdateUnscheduledAggNode",
+            )
+            return
+        assert cap_delta >= 1, f"first capacity delta must be >=1, got {cap_delta}"
+        self.cm.add_arc(
+            unsched, self.sink_node, 0, cap_delta, cost, ArcType.OTHER,
+            ChangeType.ADD_ARC_FROM_UNSCHED, "UpdateUnscheduledAggNode",
+        )
+
+    # -- preference pruning ------------------------------------------------
+
+    def _remove_invalid_ec_pref_arcs(self, node: Node, pref_ecs: List[int], change_type: ChangeType) -> None:
+        """Reference: graph_manager.go:732-760."""
+        pref = set(pref_ecs)
+        to_delete = [
+            arc
+            for arc in node.outgoing.values()
+            if arc.dst_node.equiv_class is not None and arc.dst_node.equiv_class not in pref
+        ]
+        for arc in to_delete:
+            self.cm.delete_arc(arc, change_type, "RemoveInvalidECPrefArcs")
+
+    def _remove_invalid_pref_res_arcs(self, node: Node, pref_rids: List[int], change_type: ChangeType) -> None:
+        """Reference: graph_manager.go:766-790 — prunes arcs to resources
+        no longer preferred, skipping running arcs is NOT done there; the
+        running arc always points at the bound resource which the cost
+        model keeps in its preference lists when relevant."""
+        pref = set(pref_rids)
+        to_delete = [
+            arc
+            for arc in node.outgoing.values()
+            if arc.dst_node.resource_id != 0 and arc.dst_node.resource_id not in pref
+        ]
+        for arc in to_delete:
+            self.cm.delete_arc(arc, change_type, "RemoveInvalidPrefResArcs")
+
+    # -- scheduled-task arc handling ---------------------------------------
+
+    def _update_arcs_for_scheduled_task(self, task_node: Node, res_node: Node) -> None:
+        """Reference: graph_manager.go:855-888."""
+        if not self.preemption:
+            self._pin_task_to_node(task_node, res_node)
+            return
+        task_id = task_node.task.uid
+        new_cost = self.cost_model.task_continuation_cost(task_id)
+        running_arc = self.task_to_running_arc.get(task_id)
+        if running_arc is not None:
+            running_arc.type = ArcType.RUNNING
+            self.cm.change_arc(running_arc, 0, 1, new_cost, ChangeType.CHG_ARC_RUNNING_TASK,
+                               "UpdateArcsForScheduledTask: transform to running arc")
+            self._update_running_task_to_unscheduled_agg_arc(task_node)
+            return
+        running_arc = self.cm.add_arc(
+            task_node, res_node, 0, 1, new_cost, ArcType.RUNNING,
+            ChangeType.ADD_ARC_RUNNING_TASK, "UpdateArcsForScheduledTask: add running arc",
+        )
+        assert task_id not in self.task_to_running_arc
+        self.task_to_running_arc[task_id] = running_arc
+        self._update_running_task_to_unscheduled_agg_arc(task_node)
+
+    def _pin_task_to_node(self, task_node: Node, res_node: Node) -> None:
+        """Preemption-off path: delete all non-chosen arcs, keep/create one
+        running arc with lower bound 1 (reference: graph_manager.go:675-720)."""
+        added_running_arc = False
+        task_id = task_node.task.uid
+        for arc in list(task_node.outgoing.values()):
+            if arc.dst != res_node.id:
+                self.cm.delete_arc(arc, ChangeType.DEL_ARC_TASK_TO_EQUIV_CLASS, "PinTaskToNode")
+                continue
+            added_running_arc = True
+            new_cost = self.cost_model.task_continuation_cost(task_id)
+            arc.type = ArcType.RUNNING
+            self.cm.change_arc(arc, 1, 1, new_cost, ChangeType.CHG_ARC_RUNNING_TASK,
+                               "PinTaskToNode: transform to running arc")
+            assert task_id not in self.task_to_running_arc
+            self.task_to_running_arc[task_id] = arc
+        self._update_unscheduled_agg_node(self.job_unsched_to_node[task_node.job_id], -1)
+        if not added_running_arc:
+            new_cost = self.cost_model.task_continuation_cost(task_id)
+            arc = self.cm.add_arc(
+                task_node, res_node, 1, 1, new_cost, ArcType.RUNNING,
+                ChangeType.ADD_ARC_RUNNING_TASK, "PinTaskToNode: add running arc",
+            )
+            assert task_id not in self.task_to_running_arc
+            self.task_to_running_arc[task_id] = arc

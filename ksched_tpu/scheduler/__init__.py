@@ -1,0 +1,3 @@
+from .flow_scheduler import FlowScheduler, RoundTiming
+
+__all__ = ["FlowScheduler", "RoundTiming"]

@@ -1,0 +1,12 @@
+from .base import FlowResult, FlowSolver
+from .cpu_ref import ReferenceSolver
+from .decode import flow_to_mapping
+from .placement import PlacementSolver
+
+__all__ = [
+    "FlowResult",
+    "FlowSolver",
+    "ReferenceSolver",
+    "flow_to_mapping",
+    "PlacementSolver",
+]

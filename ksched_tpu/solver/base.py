@@ -1,0 +1,51 @@
+"""L5': the solver-dispatch boundary.
+
+Reference: scheduling/flow/placement/solver.go:36-38 — a single-method
+``Solve() -> TaskMapping`` seam behind which the MCMF backend lives. The
+TPU build keeps the seam but the wire format is flat arrays
+(graph/device_export.FlowProblem) instead of DIMACS text, and three
+backends plug in:
+
+- ReferenceSolver (solver/cpu_ref.py): exact successive-shortest-path
+  oracle, pure Python — the mock-solver/test oracle the reference lacks;
+- NativeSolver (solver/native.py): in-process C++ library, the
+  Flowlessly-equivalent CPU production backend;
+- JaxSolver (solver/jax_solver.py): jit cost-scaling push-relabel on TPU,
+  warm-started across rounds — the centerpiece of the rebuild.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..graph.device_export import FlowProblem
+
+
+@dataclass
+class FlowResult:
+    """A feasible min-cost flow over a FlowProblem's arc slots.
+
+    ``flow`` excludes lower-bound offsets; add ``problem.flow_offset`` to
+    recover total arc flow. ``objective`` is the total cost including the
+    lower-bound flow's cost.
+    """
+
+    flow: np.ndarray  # int64[M]
+    objective: int
+    iterations: int = 0
+
+    def total_flow(self, problem: FlowProblem) -> np.ndarray:
+        return self.flow + problem.flow_offset
+
+
+class FlowSolver(abc.ABC):
+    """A min-cost max-flow backend over flat arrays."""
+
+    @abc.abstractmethod
+    def solve(self, problem: FlowProblem) -> FlowResult: ...
+
+    def reset(self) -> None:
+        """Drop warm-start state (e.g. after a full graph rebuild)."""

@@ -1,0 +1,144 @@
+"""Exact CPU reference MCMF solver (the parity oracle).
+
+Successive shortest paths with Johnson potentials and Dijkstra over the
+residual graph. This fills the gap the reference left open — it has no
+in-process mock solver, its integration test needs the real Flowlessly
+binary on disk (SURVEY §4). Pure Python; intended for tests and small
+graphs, not the hot path.
+
+Algorithm: standard SSP. All supplies route to demands; optimality by
+nonnegative reduced costs maintained via potentials. Negative arc costs
+are handled by a Bellman-Ford potential bootstrap.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..graph.device_export import FlowProblem
+from .base import FlowResult, FlowSolver
+
+_INF = float("inf")
+
+
+class ReferenceSolver(FlowSolver):
+    def solve(self, problem: FlowProblem) -> FlowResult:
+        n = problem.num_nodes
+        m = len(problem.src)
+        src = problem.src
+        dst = problem.dst
+        cap = problem.cap.astype(np.int64)
+        cost = problem.cost.astype(np.int64)
+        excess = problem.excess.astype(np.int64).copy()
+
+        # Residual adjacency: per node, list of (arc_index, direction).
+        # direction +1 = forward residual (cap - flow), -1 = backward (flow).
+        adj: List[List[Tuple[int, int]]] = [[] for _ in range(n)]
+        live = np.nonzero(cap > 0)[0]
+        for i in live:
+            adj[src[i]].append((int(i), +1))
+            adj[dst[i]].append((int(i), -1))
+
+        flow = np.zeros(m, dtype=np.int64)
+        potential = [0] * n
+
+        if (cost[live] < 0).any() if len(live) else False:
+            self._bellman_ford_potentials(n, live, src, dst, cost, potential)
+
+        supplies = [v for v in range(n) if excess[v] > 0]
+        total_pushed = 0
+        iterations = 0
+        while supplies:
+            s_set = [v for v in supplies if excess[v] > 0]
+            if not s_set:
+                break
+            dist, parent_arc, parent_dir, reached_demand = self._dijkstra(
+                n, adj, src, dst, cap, cost, flow, potential, s_set, excess
+            )
+            if reached_demand is None:
+                raise RuntimeError(
+                    "infeasible flow problem: supply cannot reach any demand "
+                    "(the unscheduled-aggregator escape arcs should prevent this)"
+                )
+            # Update potentials for all reached nodes.
+            d_t = dist[reached_demand]
+            for v in range(n):
+                if dist[v] < _INF:
+                    potential[v] += min(dist[v], d_t)
+                else:
+                    potential[v] += d_t
+            # Trace path back, find bottleneck.
+            path: List[Tuple[int, int]] = []
+            v = reached_demand
+            while parent_arc[v] != -1:
+                i, d = parent_arc[v], parent_dir[v]
+                path.append((i, d))
+                v = src[i] if d == +1 else dst[i]
+            source = v
+            bottleneck = min(excess[source], -excess[reached_demand])
+            for i, d in path:
+                residual = cap[i] - flow[i] if d == +1 else flow[i]
+                bottleneck = min(bottleneck, residual)
+            assert bottleneck > 0
+            for i, d in path:
+                flow[i] += bottleneck * d
+            excess[source] -= bottleneck
+            excess[reached_demand] += bottleneck
+            total_pushed += bottleneck
+            iterations += 1
+            supplies = [v for v in supplies if excess[v] > 0]
+
+        objective = int((flow * cost).sum() + (problem.flow_offset.astype(np.int64) * cost).sum())
+        return FlowResult(flow=flow, objective=objective, iterations=iterations)
+
+    @staticmethod
+    def _dijkstra(n, adj, src, dst, cap, cost, flow, potential, sources, excess):
+        dist = [_INF] * n
+        parent_arc = [-1] * n
+        parent_dir = [0] * n
+        pq: List[Tuple[float, int]] = []
+        for s in sources:
+            dist[s] = 0.0
+            heapq.heappush(pq, (0.0, s))
+        best_demand = None
+        while pq:
+            d, v = heapq.heappop(pq)
+            if d > dist[v]:
+                continue
+            if excess[v] < 0:
+                best_demand = v
+                break
+            for i, direction in adj[v]:
+                if direction == +1:
+                    residual = cap[i] - flow[i]
+                    w = dst[i]
+                    rc = cost[i] + potential[v] - potential[w]
+                else:
+                    residual = flow[i]
+                    w = src[i]
+                    rc = -cost[i] + potential[v] - potential[w]
+                if residual <= 0:
+                    continue
+                nd = d + rc
+                if nd < dist[w] - 1e-9:
+                    dist[w] = nd
+                    parent_arc[w] = i
+                    parent_dir[w] = direction
+                    heapq.heappush(pq, (nd, w))
+        return dist, parent_arc, parent_dir, best_demand
+
+    @staticmethod
+    def _bellman_ford_potentials(n, live, src, dst, cost, potential):
+        for _ in range(n):
+            changed = False
+            for i in live:
+                u, v = src[i], dst[i]
+                if potential[u] + cost[i] < potential[v]:
+                    potential[v] = potential[u] + cost[i]
+                    changed = True
+            if not changed:
+                return
+        raise RuntimeError("negative cost cycle in flow network")

@@ -1,0 +1,55 @@
+"""The placement solver driver: graph manager → backend → task mapping.
+
+Reference: scheduling/flow/placement/solver.go:60-123. Round 1 exports
+the full graph; round N first refreshes task→unsched costs
+(UpdateAllCostsToUnscheduledAggs) and then ships only the journaled
+changes. In the reference the export is DIMACS text to a daemon
+subprocess; here it is a scatter into the flat device arrays
+(DeviceGraphState), and the backend is called in-process.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..graph.device_export import DeviceGraphState
+from ..graph.graph_manager import GraphManager, TaskMapping
+from .base import FlowSolver
+from .decode import flow_to_mapping
+
+
+class PlacementSolver:
+    def __init__(self, gm: GraphManager, backend: FlowSolver, incremental: bool = True) -> None:
+        self.gm = gm
+        self.backend = backend
+        self.incremental = incremental
+        self.state = DeviceGraphState()
+        self._started = False
+        self.last_result = None
+
+    def solve(self) -> TaskMapping:
+        gm = self.gm
+        if not self._started or not self.incremental:
+            self._started = True
+            self.state.full_build(gm.cm.graph)
+            gm.cm.reset_changes()
+            self.backend.reset()
+        else:
+            gm.update_all_costs_to_unscheduled_aggs()
+            self.state.apply_changes(gm.cm.get_optimized_graph_changes())
+            gm.cm.reset_changes()
+        # Sink excess is maintained outside the journal (reference:
+        # graph_manager.go:636-640); sync it before each solve.
+        self.state.set_excess(gm.sink_node.id, gm.sink_node.excess)
+
+        problem = self.state.problem()
+        result = self.backend.solve(problem)
+        self.last_result = result
+        task_node_ids = [node.id for node in gm.task_to_node.values()]
+        return flow_to_mapping(
+            problem,
+            result.total_flow(problem),
+            gm.leaf_node_ids,
+            gm.sink_node.id,
+            task_node_ids,
+        )

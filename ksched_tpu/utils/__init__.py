@@ -1,0 +1,26 @@
+from .ids import (
+    IDGenerator,
+    SlotAllocator,
+    equiv_class_from_bytes,
+    fnv1a_64,
+    job_id_from_string,
+    rand_uint64,
+    resource_id_from_string,
+    seed_rng,
+)
+from .maps import JobMap, ResourceMap, ResourceStatus, TaskMap
+
+__all__ = [
+    "IDGenerator",
+    "SlotAllocator",
+    "equiv_class_from_bytes",
+    "fnv1a_64",
+    "job_id_from_string",
+    "rand_uint64",
+    "resource_id_from_string",
+    "seed_rng",
+    "JobMap",
+    "ResourceMap",
+    "ResourceStatus",
+    "TaskMap",
+]

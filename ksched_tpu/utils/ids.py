@@ -1,0 +1,88 @@
+"""ID generation, hashing, and parsing utilities.
+
+Reference: pkg/util/util.go:12-86 and pkg/util/idgenerator/id_generator.go.
+TaskID / JobID / ResourceID / EquivClass are plain Python ints throughout
+(uint64-valued); descriptors carry them stringified in their uuid/job_id
+fields exactly like the reference carries stringified uint64s.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Deque, Optional
+
+_FNV_OFFSET = 14695981039346656037
+_FNV_PRIME = 1099511628211
+_U64 = (1 << 64) - 1
+
+
+def fnv1a_64(data: bytes) -> int:
+    """FNV-1a 64-bit hash (reference: pkg/util/util.go:12-16 uses FNV to
+    derive equivalence-class ids from byte strings)."""
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _U64
+    return h
+
+
+def equiv_class_from_bytes(data: bytes) -> int:
+    return fnv1a_64(data)
+
+
+_rng = random.Random()
+
+
+def seed_rng(seed: int) -> None:
+    """Determinism hook for tests (reference: pkg/util/util.go:52-58)."""
+    _rng.seed(seed)
+
+
+def rand_uint64() -> int:
+    """Uniform uint64 (the reference's RandUint64 at pkg/util/util.go:68-71
+    sums two uint32s and is biased; we fix that here)."""
+    return _rng.getrandbits(64)
+
+
+def resource_id_from_string(s: str) -> int:
+    """Parse a stringified uint64 resource id (reference: pkg/util/util.go:17-26)."""
+    return int(s)
+
+
+def job_id_from_string(s: str) -> int:
+    """Parse a stringified uint64 job id (reference: pkg/util/util.go:28-36)."""
+    return int(s)
+
+
+class IDGenerator:
+    """Sequential unique ids with free-list recycling (reference:
+    pkg/util/idgenerator/id_generator.go:13-76). Dense, stable integer ids
+    are load-bearing in the TPU build: they index directly into the flat
+    device arrays."""
+
+    def __init__(self, start: int = 1):
+        self._next = start
+        self._free: Deque[int] = deque()
+
+    def take(self) -> int:
+        if self._free:
+            return self._free.popleft()
+        nid = self._next
+        self._next += 1
+        return nid
+
+    def give_back(self, id_: int) -> None:
+        self._free.append(id_)
+
+    @property
+    def high_water_mark(self) -> int:
+        """One past the largest id ever handed out; the dense array length."""
+        return self._next
+
+
+class SlotAllocator(IDGenerator):
+    """IDGenerator starting at 0, for dense array-slot assignment."""
+
+    def __init__(self) -> None:
+        super().__init__(start=0)

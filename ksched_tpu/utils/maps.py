@@ -1,0 +1,78 @@
+"""Typed object maps for resources, jobs, and tasks.
+
+Reference: pkg/types/types.go:38-294 (RWMutex-guarded ResourceMap/JobMap/
+TaskMap) and pkg/types/resourcestatus/resourcestatus.go:22-27. The core
+scheduling loop is single-threaded by design (reference:
+scheduling/flow/placement/solver.go:59), so these are thin dict wrappers
+kept for API parity; cross-thread use should add external locking.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Generic, Optional, TypeVar
+
+from ..data import JobDescriptor, ResourceDescriptor, ResourceTopologyNodeDescriptor, TaskDescriptor
+
+V = TypeVar("V")
+
+
+@dataclass
+class ResourceStatus:
+    """Pairs a resource descriptor with its topology node (reference:
+    pkg/types/resourcestatus/resourcestatus.go:22-27)."""
+
+    descriptor: ResourceDescriptor
+    topology_node: Optional[ResourceTopologyNodeDescriptor] = None
+    endpoint_uri: str = ""
+    last_heartbeat: int = 0
+
+
+class _TypedMap(Generic[V]):
+    def __init__(self) -> None:
+        self._m: Dict[int, V] = {}
+        self._lock = threading.RLock()
+
+    def find(self, key: int) -> Optional[V]:
+        with self._lock:
+            return self._m.get(key)
+
+    def insert(self, key: int, value: V) -> None:
+        with self._lock:
+            self._m[key] = value
+
+    def insert_if_not_present(self, key: int, value: V) -> bool:
+        with self._lock:
+            if key in self._m:
+                return False
+            self._m[key] = value
+            return True
+
+    def remove(self, key: int) -> None:
+        with self._lock:
+            self._m.pop(key, None)
+
+    def contains(self, key: int) -> bool:
+        with self._lock:
+            return key in self._m
+
+    def unsafe_get(self) -> Dict[int, V]:
+        """Direct access to the backing dict; caller is responsible for
+        not mutating concurrently (reference: types.go UnsafeGet)."""
+        return self._m
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+
+class ResourceMap(_TypedMap[ResourceStatus]):
+    pass
+
+
+class JobMap(_TypedMap[JobDescriptor]):
+    pass
+
+
+class TaskMap(_TypedMap[TaskDescriptor]):
+    pass

@@ -1,0 +1,23 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware; the driver separately dry-runs the
+multi-chip path (see __graft_entry__.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from ksched_tpu.utils import seed_rng  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded_rng():
+    seed_rng(42)
+    yield

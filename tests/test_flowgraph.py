@@ -1,0 +1,96 @@
+"""Flow-graph core unit tests (model: reference flowgraph/graph_test.go)."""
+
+from ksched_tpu.graph import ArcType, FlowGraph, NodeType
+from ksched_tpu.graph.changes import ChangeManager, ChangeType
+from ksched_tpu.graph.changes import AddNodeChange, ChangeArcChange, NewArcChange, RemoveNodeChange
+
+
+def test_add_nodes_and_arcs():
+    g = FlowGraph()
+    a, b = g.add_node(), g.add_node()
+    assert a.id == 1 and b.id == 2
+    arc = g.add_arc(a, b)
+    arc.cap_upper = 5
+    assert g.num_nodes == 2
+    assert g.num_arcs == 1
+    assert g.get_arc(a, b) is arc
+    assert g.get_arc(b, a) is None
+
+
+def test_change_arc_zero_capacity_removes_from_arc_set():
+    g = FlowGraph()
+    a, b = g.add_node(), g.add_node()
+    arc = g.add_arc(a, b)
+    g.change_arc(arc, 0, 10, 3)
+    assert g.num_arcs == 1
+    g.change_arc(arc, 0, 0, 3)
+    assert g.num_arcs == 0
+    # still attached to endpoints
+    assert g.get_arc(a, b) is arc
+    # restoring capacity re-registers it (fixes a reference gap)
+    g.change_arc(arc, 0, 4, 3)
+    assert g.num_arcs == 1
+
+
+def test_delete_node_removes_arcs_and_recycles_id():
+    g = FlowGraph()
+    a, b, c = g.add_node(), g.add_node(), g.add_node()
+    g.add_arc(a, b)
+    g.add_arc(b, c)
+    g.add_arc(c, a)
+    g.delete_node(b)
+    assert g.num_nodes == 2
+    assert g.num_arcs == 1  # only c->a survives
+    d = g.add_node()
+    assert d.id == b.id  # id recycled
+
+
+def test_change_manager_journals_mutations():
+    cm = ChangeManager()
+    n1 = cm.add_node(NodeType.SINK, 0, ChangeType.ADD_SINK_NODE, "SINK")
+    n2 = cm.add_node(NodeType.UNSCHEDULED_TASK, 1, ChangeType.ADD_TASK_NODE)
+    arc = cm.add_arc(n2, n1, 0, 1, 5, ArcType.OTHER, ChangeType.ADD_ARC_TO_UNSCHED)
+    changes = cm.get_graph_changes()
+    assert len(changes) == 3
+    assert isinstance(changes[0], AddNodeChange)
+    assert isinstance(changes[2], NewArcChange)
+
+    # idempotent change journals nothing
+    cm.change_arc(arc, 0, 1, 5, ChangeType.CHG_ARC_TO_UNSCHED)
+    assert len(cm.get_graph_changes()) == 3
+
+    # repeated updates to one arc are merged into the NewArc entry
+    cm.change_arc(arc, 0, 1, 7, ChangeType.CHG_ARC_TO_UNSCHED)
+    cm.change_arc(arc, 0, 2, 7, ChangeType.CHG_ARC_TO_UNSCHED)
+    changes = cm.get_graph_changes()
+    assert len(changes) == 3
+    merged = changes[2]
+    assert isinstance(merged, NewArcChange)
+    assert merged.cost == 7 and merged.cap_upper == 2
+
+    cm.reset_changes()
+    assert not cm.has_changes
+
+    cm.delete_arc(arc, ChangeType.DEL_ARC_TASK_TO_RES)
+    changes = cm.get_graph_changes()
+    assert len(changes) == 1
+    assert isinstance(changes[0], ChangeArcChange)
+    assert changes[0].cap_upper == 0 and changes[0].cap_lower == 0
+
+    cm.delete_node(n2, ChangeType.DEL_TASK_NODE)
+    assert isinstance(cm.get_graph_changes()[-1], RemoveNodeChange)
+
+
+def test_change_stats_counts():
+    cm = ChangeManager()
+    n1 = cm.add_node(NodeType.SINK, 0, ChangeType.ADD_SINK_NODE)
+    n2 = cm.add_node(NodeType.UNSCHEDULED_TASK, 1, ChangeType.ADD_TASK_NODE)
+    cm.add_arc(n2, n1, 0, 1, 5, ArcType.OTHER, ChangeType.ADD_ARC_TO_UNSCHED)
+    s = cm.stats
+    assert s.nodes_added == 2
+    assert s.arcs_added == 1
+    assert s.by_type[ChangeType.ADD_TASK_NODE] == 1
+    csv = s.to_csv()
+    assert csv.startswith("2,0,1,0,0")
+    s.reset()
+    assert s.nodes_added == 0
