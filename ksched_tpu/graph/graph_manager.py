@@ -743,10 +743,16 @@ class GraphManager:
         task_id = task_node.task.uid
         new_cost = self.cost_model.task_continuation_cost(task_id)
         running_arc = self.task_to_running_arc.get(task_id)
+        if running_arc is None:
+            # A preference arc to the chosen resource doubles as the
+            # running arc (the graph doesn't support multi-arcs; reference
+            # note at graph_manager.go:869-872).
+            running_arc = self.cm.graph.get_arc(task_node, res_node)
         if running_arc is not None:
             running_arc.type = ArcType.RUNNING
             self.cm.change_arc(running_arc, 0, 1, new_cost, ChangeType.CHG_ARC_RUNNING_TASK,
                                "UpdateArcsForScheduledTask: transform to running arc")
+            self.task_to_running_arc[task_id] = running_arc
             self._update_running_task_to_unscheduled_agg_arc(task_node)
             return
         running_arc = self.cm.add_arc(

@@ -1,0 +1,336 @@
+"""The TPU MCMF backend: cost-scaling push-relabel in JAX.
+
+This is the centerpiece of the rebuild — the replacement for the
+reference's external Flowlessly C++ solver (invoked over DIMACS pipes at
+scheduling/flow/placement/solver.go:92-123). The flow network arrives as
+flat arrays (graph/device_export.py), lives in device memory, and is
+solved by a synchronous Goldberg–Tarjan cost-scaling push-relabel:
+
+- arcs are doubled into residual entries (forward + backward);
+- each superstep, every active node (excess > 0) pushes along ALL its
+  admissible arcs at once via an in-segment prefix-sum allocation
+  (maximal push), and active nodes with no admissible arc relabel;
+- simultaneous pushes/relabels preserve eps-optimality: a relabel only
+  lowers its own potential (reduced costs of in-arcs rise, and out-arc
+  bounds were computed against neighbor potentials that only decrease),
+  and opposite-direction pushes on one arc are mutually exclusive;
+- phases shrink eps by alpha until eps = 1 on costs pre-scaled by the
+  node count, at which point the flow is exactly optimal.
+
+TPU-shaped implementation notes:
+
+- NO scatters. TPU serializes scatter-adds (a 64k segment_sum measured
+  ~68 ms), so all segment reductions are expressed over a host-prebuilt
+  CSR ordering of the residual entries as cumsum + gather
+  (diff-at-row-boundaries) and a segmented max via
+  lax.associative_scan — each tens of microseconds at 64k entries.
+- The CSR ordering depends only on arc endpoints, which change far less
+  often than costs/capacities; it is cached and rebuilt on the host
+  (cheap numpy argsort) only when the arc structure changes.
+- Everything is int32: TPU v5e has no native int64 (emulation trips XLA
+  scoped-vmem issues and is slow). Scaled costs |c|*N must fit int32
+  (checked on entry); potentials are guarded against overflow.
+- Shapes are static per padded generation (power-of-two growth in
+  DeviceGraphState), so repeated rounds reuse one compiled executable.
+
+Incremental warm start (the property Flowlessly's daemon mode provides):
+potentials and flows from the previous round are reused; flows on arc
+slots whose endpoints changed are dropped, and remaining eps-optimality
+violations define the starting eps — so re-solve cost tracks the delta.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graph.device_export import FlowProblem
+from .base import FlowResult, FlowSolver
+
+_BIG = jnp.int32(1 << 30)
+_P_GUARD = 1 << 30  # potential magnitude beyond this risks int32 overflow
+
+
+@dataclass
+class CsrPlan:
+    """Host-prebuilt ordering of the doubled residual entries by source
+    node, with everything the device needs for segment reductions."""
+
+    s_arc: np.ndarray  # int32[2M] arc slot per sorted entry
+    s_sign: np.ndarray  # int32[2M] +1 forward, -1 backward
+    s_src: np.ndarray  # int32[2M]
+    s_dst: np.ndarray  # int32[2M]
+    s_segstart: np.ndarray  # int32[2M] sorted index of the entry's segment start
+    s_isstart: np.ndarray  # bool[2M] segment-start flags
+    inv_order: np.ndarray  # int32[2M] sorted position of original entry j
+    node_first: np.ndarray  # int32[N] row_ptr[:-1] clamped
+    node_last: np.ndarray  # int32[N] row_ptr[1:]-1 clamped
+    node_nonempty: np.ndarray  # bool[N]
+    src: np.ndarray  # int32[M] the endpoints this plan was built for
+    dst: np.ndarray  # int32[M]
+
+
+def build_csr_plan(src: np.ndarray, dst: np.ndarray, num_nodes: int) -> CsrPlan:
+    m = len(src)
+    esrc = np.concatenate([src, dst])
+    order = np.argsort(esrc, kind="stable").astype(np.int32)
+    s_src = esrc[order]
+    s_dst = np.concatenate([dst, src])[order]
+    s_arc = np.where(order < m, order, order - m).astype(np.int32)
+    s_sign = np.where(order < m, 1, -1).astype(np.int32)
+    inv_order = np.empty(2 * m, dtype=np.int32)
+    inv_order[order] = np.arange(2 * m, dtype=np.int32)
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    counts = np.bincount(s_src, minlength=num_nodes)
+    row_ptr[1:] = np.cumsum(counts)
+    s_segstart = row_ptr[s_src].astype(np.int32)
+    s_isstart = np.zeros(2 * m, dtype=bool)
+    s_isstart[np.unique(s_segstart)] = True
+    node_first = np.minimum(row_ptr[:-1], 2 * m - 1).astype(np.int32)
+    node_last = np.maximum(row_ptr[1:] - 1, 0).astype(np.int32)
+    node_nonempty = (row_ptr[1:] > row_ptr[:-1])
+    return CsrPlan(
+        s_arc=s_arc,
+        s_sign=s_sign,
+        s_src=s_src.astype(np.int32),
+        s_dst=s_dst.astype(np.int32),
+        s_segstart=s_segstart,
+        s_isstart=s_isstart,
+        inv_order=inv_order,
+        node_first=node_first,
+        node_last=node_last,
+        node_nonempty=node_nonempty,
+        src=src.copy(),
+        dst=dst.copy(),
+    )
+
+
+def _seg_sum(vals, node_first, node_last, node_nonempty):
+    """Per-node sum over a sorted-entry array: cumsum + boundary gathers."""
+    c = jnp.cumsum(vals)
+    excl_first = c[node_first] - vals[node_first]
+    seg = c[node_last] - excl_first
+    return jnp.where(node_nonempty, seg, 0)
+
+
+def _seg_max(vals, isstart, node_last, node_nonempty, identity):
+    """Per-node max via a segmented-max associative scan."""
+
+    def combine(a, b):
+        f1, v1 = a
+        f2, v2 = b
+        return f1 | f2, jnp.where(f2, v2, jnp.maximum(v1, v2))
+
+    _, scanned = lax.associative_scan(combine, (isstart, vals))
+    return jnp.where(node_nonempty, scanned[node_last], identity)
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
+def _solve_mcmf(
+    cap, cost, supply, p0, flow0, eps_init,
+    s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, inv_order,
+    node_first, node_last, node_nonempty,
+    alpha: int = 8,
+    max_supersteps: int = 50_000,
+):
+    m = cap.shape[0]
+    i32 = jnp.int32
+
+    def excess_of(flow):
+        flow_signed = s_sign * flow[s_arc]
+        return supply - _seg_sum(flow_signed, node_first, node_last, node_nonempty)
+
+    def saturate(flow, p):
+        """Refine step: saturate every residual entry with negative
+        reduced cost, making the pseudoflow 0-optimal for the phase."""
+        rc_fwd = cost + p[cap_src] - p[cap_dst]
+        return jnp.where(rc_fwd < 0, cap, jnp.where(rc_fwd > 0, i32(0), flow))
+
+    # Per-arc endpoints for the saturate step, recovered from the sorted
+    # entries to avoid shipping src/dst twice: arc j's forward entry sits
+    # at inv_order[j].
+    fwd_pos = inv_order[:m]
+    cap_src = s_src[fwd_pos]
+    cap_dst = s_dst[fwd_pos]
+
+    def superstep(flow, p, eps, excess):
+        a_flow = flow[s_arc]
+        r = jnp.where(s_sign > 0, cap[s_arc] - a_flow, a_flow)
+        s_cost = s_sign * cost[s_arc]
+        rc = s_cost + p[s_src] - p[s_dst]
+        e_at = excess[s_src]
+        admissible = (r > 0) & (rc < 0) & (e_at > 0)
+
+        # Maximal push: allocate each node's excess across its admissible
+        # entries front-to-back via an in-segment exclusive prefix sum.
+        r_adm = jnp.where(admissible, r, i32(0))
+        cum = jnp.cumsum(r_adm)
+        excl = cum - r_adm
+        prefix_before = excl - excl[s_segstart]
+        delta = jnp.clip(e_at - prefix_before, 0, r_adm)
+
+        delta_orig = delta[inv_order]
+        new_flow = flow + delta_orig[:m] - delta_orig[m:]
+
+        # Relabel nodes that were active but pushed nothing (maximal push
+        # guarantees active nodes with an admissible entry push >= 1).
+        pushed = _seg_sum(delta, node_first, node_last, node_nonempty)
+        sum_r = _seg_sum(r, node_first, node_last, node_nonempty)
+        cand = jnp.where(r > 0, p[s_dst] - s_cost, -_BIG)
+        best = _seg_max(cand, s_isstart, node_last, node_nonempty, -_BIG)
+        relabel = (excess > 0) & (pushed == 0) & (sum_r > 0)
+        new_p = jnp.where(relabel, best - eps, p)
+        return new_flow, new_p
+
+    def phase_cond(state):
+        _flow, _p, _eps, steps, done = state
+        return ~done & (steps < max_supersteps)
+
+    def phase_body(state):
+        flow, p, eps, steps, done = state
+        excess = excess_of(flow)
+        any_active = jnp.any(excess > 0)
+
+        def do_superstep(_):
+            f2, p2 = superstep(flow, p, eps, excess)
+            return f2, p2, eps, steps + 1, jnp.bool_(False)
+
+        def next_phase(_):
+            finished = eps <= 1
+            new_eps = jnp.maximum(i32(1), eps // alpha)
+            f2 = jnp.where(finished, flow, saturate(flow, p))
+            return f2, p, jnp.where(finished, eps, new_eps), steps, finished
+
+        return lax.cond(any_active, do_superstep, next_phase, operand=None)
+
+    flow1 = saturate(flow0, p0)  # establish eps_init-optimality
+    state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
+    flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
+    converged = done & (jnp.max(jnp.abs(excess_of(flow))) == 0)
+    p_overflow = jnp.max(jnp.abs(p)) >= _P_GUARD
+    return flow, p, steps, converged, p_overflow
+
+
+class JaxSolver(FlowSolver):
+    """Cost-scaling push-relabel on device, warm-started across rounds."""
+
+    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True):
+        self.alpha = alpha
+        self.max_supersteps = max_supersteps
+        self.warm_start = warm_start
+        self._prev: Optional[Tuple[np.ndarray, np.ndarray]] = None  # (p, flow)
+        self._plan: Optional[CsrPlan] = None
+        self._plan_dev: Optional[tuple] = None
+        self.last_supersteps = 0
+
+    def reset(self) -> None:
+        self._prev = None
+
+    def _plan_for(self, src: np.ndarray, dst: np.ndarray, n: int) -> tuple:
+        plan = self._plan
+        if plan is None or len(plan.src) != len(src) or len(plan.node_first) != n or not (
+            np.array_equal(plan.src, src) and np.array_equal(plan.dst, dst)
+        ):
+            plan = build_csr_plan(src, dst, n)
+            self._plan = plan
+            self._plan_dev = tuple(
+                jnp.asarray(x)
+                for x in (
+                    plan.s_arc, plan.s_sign, plan.s_src, plan.s_dst,
+                    plan.s_segstart, plan.s_isstart, plan.inv_order,
+                    plan.node_first, plan.node_last, plan.node_nonempty,
+                )
+            )
+            # Structure changed: stale flows are only reusable per-slot if
+            # endpoints match, checked in solve().
+        return self._plan_dev
+
+    def solve(self, problem: FlowProblem) -> FlowResult:
+        n = problem.num_nodes
+        m = len(problem.src)
+        if m == 0 or problem.num_arcs == 0:
+            if (problem.excess > 0).any():
+                raise RuntimeError("infeasible flow problem: supply but no arcs")
+            return FlowResult(flow=np.zeros(m, dtype=np.int64), objective=0, iterations=0)
+        src = problem.src.astype(np.int32)
+        dst = problem.dst.astype(np.int32)
+        cap = problem.cap.astype(np.int32)
+        supply = problem.excess.astype(np.int32)
+
+        # Pre-scale costs by the node count so eps = 1 implies exactness;
+        # the scaled range must fit int32 comfortably.
+        max_cost = int(np.abs(problem.cost).max()) if m else 0
+        if max_cost * n >= (1 << 30):
+            raise OverflowError(
+                f"scaled costs overflow int32: max|cost|={max_cost} at {n} nodes; "
+                "rescale cost-model outputs or shrink the graph padding"
+            )
+        cost = problem.cost.astype(np.int32) * np.int32(n)
+
+        prev_plan = self._plan
+        plan_dev = self._plan_for(src, dst, n)
+
+        p0 = np.zeros(n, dtype=np.int32)
+        flow0 = np.zeros(m, dtype=np.int32)
+        warm = False
+        if self.warm_start and self._prev is not None:
+            p_prev, f_prev = self._prev
+            if len(p_prev) == n and len(f_prev) == m and prev_plan is not None:
+                warm = True
+                p0 = p_prev
+                same = (prev_plan.src == src) & (prev_plan.dst == dst)
+                flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
+
+        if warm:
+            # Start eps at the largest eps-optimality violation of the
+            # carried-over state: re-solve cost tracks the delta size.
+            rc = cost.astype(np.int64) + p0[src].astype(np.int64) - p0[dst].astype(np.int64)
+            viol = 0
+            fwd_live = cap > flow0
+            if fwd_live.any():
+                viol = max(viol, int(np.max(-rc[fwd_live])))
+            bwd_live = flow0 > 0
+            if bwd_live.any():
+                viol = max(viol, int(np.max(rc[bwd_live])))
+            eps_init = max(1, viol)
+        else:
+            eps_init = max(1, max_cost * n)
+
+        flow, p, steps, converged, p_overflow = _solve_mcmf(
+            jnp.asarray(cap),
+            jnp.asarray(cost),
+            jnp.asarray(supply),
+            jnp.asarray(p0),
+            jnp.asarray(flow0),
+            jnp.asarray(np.int32(eps_init)),
+            *plan_dev,
+            alpha=self.alpha,
+            max_supersteps=self.max_supersteps,
+        )
+        if warm and (not bool(converged) or bool(p_overflow)):
+            # Warm start led the search astray (e.g. a large structural
+            # delta): retry cold rather than failing the round.
+            self._prev = None
+            return self.solve(problem)
+        self.last_supersteps = int(steps)
+        if bool(p_overflow):
+            raise OverflowError("push-relabel potentials approached int32 range")
+        if not bool(converged):
+            raise RuntimeError(
+                f"push-relabel did not converge within {self.max_supersteps} supersteps; "
+                "the flow problem may be infeasible (missing unscheduled-aggregator arcs?)"
+            )
+        flow_np = np.asarray(flow)
+        if self.warm_start:
+            self._prev = (np.asarray(p), flow_np)
+        objective = int(
+            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+            + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+        )
+        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))
