@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Benchmark: p50 scheduling-round latency at 10k tasks x 1k machines.
+
+The driver-set north star (BASELINE.json): <10 ms p50 round latency on a
+10k-task / 1k-machine flow graph with the trivial cost model, solved by
+the JAX/TPU backend. The measurement point mirrors the reference's round
+timer around ScheduleAllJobs (cmd/k8sscheduler/scheduler.go:146-150):
+one round = stats/capacity refresh + solve + decode + apply.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}
+
+vs_baseline is target_ms / p50_ms (>= 1.0 means the 10 ms target is met).
+
+Steady-state protocol: fill the cluster to ~95%, then each round
+complete ~1% of running tasks and admit the same number of new ones —
+the incremental re-solve regime Flowlessly's daemon mode serves in the
+reference. Use --cold for full from-scratch solves instead.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _accelerator_alive(timeout_s: float = 90.0) -> bool:
+    """Probe the ambient accelerator in a subprocess: a wedged TPU tunnel
+    hangs backend init forever, which must not take the benchmark down."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def build(args):
+    from ksched_tpu.scheduler.bulk import BulkCluster
+    from ksched_tpu.solver.jax_solver import JaxSolver
+
+    backend = JaxSolver(warm_start=not args.cold)
+    cluster = BulkCluster(
+        num_machines=args.machines,
+        pus_per_machine=args.pus,
+        slots_per_pu=args.slots,
+        num_jobs=args.jobs,
+        backend=backend,
+        task_capacity=args.tasks + 4096,
+    )
+    return cluster, backend
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--machines", type=int, default=1_000)
+    ap.add_argument("--pus", type=int, default=4, help="PUs per machine")
+    ap.add_argument("--slots", type=int, default=4, help="slots per PU")
+    ap.add_argument("--jobs", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--churn", type=float, default=0.01)
+    ap.add_argument("--cold", action="store_true", help="no warm start between rounds")
+    ap.add_argument("--small", action="store_true", help="quick smoke (100 tasks x 10 machines)")
+    ap.add_argument("--cpu", action="store_true", help="force JAX cpu backend")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    if args.small:
+        args.tasks, args.machines, args.rounds = 100, 10, 10
+    if not args.cpu and not _accelerator_alive():
+        print("# accelerator unreachable; falling back to cpu", file=sys.stderr)
+        args.cpu = True
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from ksched_tpu.utils import force_cpu_platform
+
+        force_cpu_platform()
+
+    import jax
+
+    rng = np.random.default_rng(0)
+    cluster, backend = build(args)
+    devices = jax.devices()
+
+    # Fill: admit all tasks, run rounds until placements settle.
+    job_ids = rng.integers(0, args.jobs, args.tasks).astype(np.int32)
+    cluster.add_tasks(args.tasks, job_ids)
+    t0 = time.perf_counter()
+    r = cluster.round()
+    fill_s = time.perf_counter() - t0
+    if args.verbose:
+        print(
+            f"# fill: placed {len(r.placed_tasks)}/{args.tasks} in {fill_s:.2f}s "
+            f"(cold solve, incl. compile), unsched={r.num_unscheduled}, "
+            f"supersteps={backend.last_supersteps}",
+            file=sys.stderr,
+        )
+
+    # Steady state: churn + measure.
+    churn_n = max(1, int(args.tasks * args.churn))
+    lat_ms = []
+    for i in range(args.rounds):
+        placed_rows = np.nonzero(cluster.task_pu >= 0)[0]
+        done = rng.choice(placed_rows, size=min(churn_n, len(placed_rows)), replace=False)
+        t0 = time.perf_counter()
+        cluster.complete_tasks(cluster.task0 + done.astype(np.int32))
+        cluster.add_tasks(churn_n, rng.integers(0, args.jobs, churn_n).astype(np.int32))
+        r = cluster.round()
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+        if args.verbose:
+            t = r.timing
+            print(
+                f"# round {i}: {lat_ms[-1]:.2f}ms placed={len(r.placed_tasks)} "
+                f"(solve={t['solve_s']*1e3:.2f} decode={t['decode_s']*1e3:.2f} "
+                f"stats={t['stats_s']*1e3:.2f} apply={t['apply_s']*1e3:.2f}) "
+                f"supersteps={backend.last_supersteps}",
+                file=sys.stderr,
+            )
+
+    p50 = float(np.percentile(lat_ms, 50))
+    target_ms = 10.0
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"p50 scheduling-round latency, {args.tasks} tasks x "
+                    f"{args.machines} machines, trivial cost model, "
+                    f"{args.churn:.0%} churn, backend={devices[0].platform}"
+                ),
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(target_ms / p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from .sharded_solver import ShardedJaxSolver, ShardedPlan, build_sharded_plan, make_sharded_solver
+
+__all__ = [
+    "ShardedJaxSolver",
+    "ShardedPlan",
+    "build_sharded_plan",
+    "make_sharded_solver",
+]

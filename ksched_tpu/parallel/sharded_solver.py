@@ -1,0 +1,414 @@
+"""Multi-chip MCMF: the push-relabel solve sharded over a device mesh.
+
+The reference scales by incremental re-solves in one external process
+(SURVEY §2.5); the TPU rebuild scales across chips: residual entries are
+partitioned by the OWNER of their source node (so every node's outgoing
+entries — the unit of push/relabel work — live on exactly one shard),
+while flow and potentials are replicated and combined with
+`jax.lax.psum` over the mesh axis each superstep. ICI traffic per
+superstep is one [N] node-vector and one [M] arc-vector reduction.
+
+Design invariants (mirroring solver/jax_solver.py, which documents the
+algorithm):
+- no scatters: per-shard segment reductions use the same CSR-sorted
+  cumsum/gather + associative-scan machinery; cross-shard combination is
+  psum of owner-masked dense vectors (each node/arc has exactly one
+  contributing shard, so psum implements "select the owner's value");
+- pushes and relabels for a node are computed entirely on its owner
+  shard from replicated state, so the single-chip eps-optimality
+  argument carries over unchanged;
+- price tightening (Bellman-Ford sweeps) distributes the same way: the
+  per-node min over outgoing entries is owner-local, then psum-combined.
+
+Built for `jax.sharding.Mesh` + `shard_map`; exercised on a virtual
+8-device CPU mesh in tests and by __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..graph.device_export import FlowProblem
+from ..solver.base import FlowResult, FlowSolver
+
+_BIG = jnp.int32(1 << 30)
+_BIG_D = 1 << 28
+
+
+@dataclass
+class ShardedPlan:
+    """Host-prebuilt per-shard CSR data, stacked on a leading shard axis."""
+
+    # [D, E] per-shard sorted entries (E = padded per-shard entry count)
+    s_arc: np.ndarray
+    s_sign: np.ndarray
+    s_src: np.ndarray
+    s_dst: np.ndarray
+    s_segstart: np.ndarray  # local sorted index of entry's segment start
+    s_isstart: np.ndarray
+    s_valid: np.ndarray  # bool, padding mask
+    # [D, N] per-node segment boundaries within the shard's local entries
+    node_first: np.ndarray
+    node_last: np.ndarray
+    node_nonempty: np.ndarray
+    owned: np.ndarray  # bool [D, N]: shard owns this node
+    # [D, M] position of arc j's fwd/bwd entry in this shard (E = zero pad)
+    pos_fwd: np.ndarray
+    pos_bwd: np.ndarray
+    src: np.ndarray  # [M] the endpoints this plan was built for
+    dst: np.ndarray
+
+
+def node_owner(node_ids: np.ndarray, num_nodes: int, num_shards: int) -> np.ndarray:
+    """Owner shard per node: contiguous range partition, so resource
+    subtrees laid out contiguously stay on one shard."""
+    per = (num_nodes + num_shards - 1) // num_shards
+    return np.minimum(node_ids // per, num_shards - 1)
+
+
+def build_sharded_plan(src: np.ndarray, dst: np.ndarray, num_nodes: int, num_shards: int) -> ShardedPlan:
+    m = len(src)
+    esrc = np.concatenate([src, dst])
+    edst = np.concatenate([dst, src])
+    earc = np.concatenate([np.arange(m), np.arange(m)]).astype(np.int32)
+    esign = np.concatenate([np.ones(m), -np.ones(m)]).astype(np.int32)
+    owner = node_owner(esrc, num_nodes, num_shards)
+
+    per_shard = [np.nonzero(owner == d)[0] for d in range(num_shards)]
+    e_max = max((len(ix) for ix in per_shard), default=1)
+    # One spare slot past the densest shard: pos_fwd/pos_bwd default
+    # there, and it is invalid on every shard, so padded gathers read 0.
+    e_pad = e_max + 1
+
+    def stack(fill, dtype):
+        return np.full((num_shards, e_pad), fill, dtype=dtype)
+
+    s_arc = stack(0, np.int32)
+    s_sign = stack(1, np.int32)
+    s_src = stack(0, np.int32)
+    s_dst = stack(0, np.int32)
+    s_segstart = stack(0, np.int32)
+    s_isstart = np.zeros((num_shards, e_pad), bool)
+    s_valid = np.zeros((num_shards, e_pad), bool)
+    node_first = np.zeros((num_shards, num_nodes), np.int32)
+    node_last = np.zeros((num_shards, num_nodes), np.int32)
+    node_nonempty = np.zeros((num_shards, num_nodes), bool)
+    owned = np.zeros((num_shards, num_nodes), bool)
+    pos_fwd = np.full((num_shards, m), e_pad - 1, np.int32)
+    pos_bwd = np.full((num_shards, m), e_pad - 1, np.int32)
+
+    node_ids = np.arange(num_nodes)
+    node_owner_arr = node_owner(node_ids, num_nodes, num_shards)
+    for d in range(num_shards):
+        ix = per_shard[d]
+        k = len(ix)
+        order = np.argsort(esrc[ix], kind="stable")
+        lsrc = esrc[ix][order]
+        s_src[d, :k] = lsrc
+        s_dst[d, :k] = edst[ix][order]
+        s_arc[d, :k] = earc[ix][order]
+        s_sign[d, :k] = esign[ix][order]
+        s_valid[d, :k] = True
+        counts = np.bincount(lsrc, minlength=num_nodes)
+        row_ptr = np.zeros(num_nodes + 1, np.int64)
+        row_ptr[1:] = np.cumsum(counts)
+        s_segstart[d, :k] = row_ptr[lsrc]
+        starts = np.unique(row_ptr[lsrc]).astype(np.int64)
+        s_isstart[d, starts] = True
+        node_first[d] = np.minimum(row_ptr[:-1], max(e_pad - 1, 0))
+        node_last[d] = np.maximum(row_ptr[1:] - 1, 0)
+        node_nonempty[d] = row_ptr[1:] > row_ptr[:-1]
+        owned[d] = node_owner_arr == d
+        # Map arc -> local entry position (padding position reads delta 0
+        # because padded entries are never admissible).
+        local_pos = np.empty(k, np.int64)
+        local_pos[:] = np.arange(k)
+        glob = ix[order]
+        is_fwd = glob < m
+        pos_fwd[d, earc[ix][order][is_fwd]] = local_pos[is_fwd]
+        pos_bwd[d, earc[ix][order][~is_fwd]] = local_pos[~is_fwd]
+    return ShardedPlan(
+        s_arc=s_arc,
+        s_sign=s_sign,
+        s_src=s_src,
+        s_dst=s_dst,
+        s_segstart=s_segstart,
+        s_isstart=s_isstart,
+        s_valid=s_valid,
+        node_first=node_first,
+        node_last=node_last,
+        node_nonempty=node_nonempty,
+        owned=owned,
+        pos_fwd=pos_fwd,
+        pos_bwd=pos_bwd,
+        src=src.copy(),
+        dst=dst.copy(),
+    )
+
+
+from ..solver.jax_solver import _seg_sum as _seg_sum_local  # same CSR layout
+
+
+def _seg_scan(vals, isstart, combine_val):
+    def combine(a, b):
+        f1, v1 = a
+        f2, v2 = b
+        return f1 | f2, jnp.where(f2, v2, combine_val(v1, v2))
+
+    _, scanned = lax.associative_scan(combine, (isstart, vals))
+    return scanned
+
+
+def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, tighten_sweeps: int = 32):
+    """Build the jitted sharded solve fn over the given mesh axis. The
+    per-shard plan arrays arrive as call arguments (sharded on their
+    leading axis); nothing is baked into the compiled function besides
+    shapes."""
+    from jax import shard_map
+
+    spec_sharded = P(axis)
+    spec_repl = P()
+
+    def solve_shard(
+        cap, cost, supply, flow0, eps_init, step_cap,
+        s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, s_valid,
+        node_first, node_last, node_nonempty, owned, pos_fwd, pos_bwd,
+    ):
+        # Inside shard_map: leading shard axis is stripped; arrays are
+        # the local shard's slices. cap/cost/supply/flow0 replicated.
+        i32 = jnp.int32
+        (s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, s_valid,
+         node_first, node_last, node_nonempty, owned, pos_fwd, pos_bwd) = (
+            x[0] for x in (s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, s_valid,
+                           node_first, node_last, node_nonempty, owned, pos_fwd, pos_bwd)
+        )
+        s_cost = s_sign * cost[s_arc]
+
+        def residual(flow):
+            a_flow = flow[s_arc]
+            r = jnp.where(s_sign > 0, cap[s_arc] - a_flow, a_flow)
+            return jnp.where(s_valid, r, i32(0))
+
+        def excess_of(flow):
+            contrib = _seg_sum_local(
+                jnp.where(s_valid, s_sign * flow[s_arc], i32(0)),
+                node_first, node_last, node_nonempty,
+            )
+            contrib = jnp.where(owned, contrib, i32(0))
+            total = lax.psum(contrib, axis)
+            return supply - total
+
+        def tighten(flow):
+            r = residual(flow)
+            excess0 = excess_of(flow)
+            d0 = jnp.where(excess0 < 0, i32(0), i32(_BIG_D))
+
+            def t_cond(state):
+                _d, changed, it = state
+                return changed & (it < tighten_sweeps)
+
+            def t_body(state):
+                d, _, it = state
+                cand = jnp.where(r > 0, s_cost + d[s_dst], i32(_BIG_D))
+                scanned = _seg_scan(cand, s_isstart, jnp.minimum)
+                best = jnp.where(node_nonempty, scanned[node_last], i32(_BIG_D))
+                best = jnp.where(owned, best, i32(_BIG_D))
+                best = lax.pmin(best, axis)
+                # clamp below: transient negative-cost residual cycles
+                # must not run d toward int32 wraparound
+                d2 = jnp.maximum(jnp.minimum(d, best), -i32(_BIG_D))
+                return d2, jnp.any(d2 != d), it + 1
+
+            d, _, _ = lax.while_loop(t_cond, t_body, (d0, jnp.bool_(True), i32(0)))
+            return -jnp.minimum(d, i32(_BIG_D))
+
+        # pos_fwd/pos_bwd point either at the arc's real local entry or
+        # at the spare padded slot (invalid on every shard), so gathers
+        # through them read 0 after the s_valid mask.
+        def arc_delta(delta):
+            dz = jnp.where(s_valid, delta, i32(0))
+            return lax.psum(dz[pos_fwd] - dz[pos_bwd], axis)
+
+        def superstep(flow, p, eps, excess):
+            r = residual(flow)
+            rc = s_cost + p[s_src] - p[s_dst]
+            e_at = excess[s_src]
+            admissible = (r > 0) & (rc < 0) & (e_at > 0) & s_valid
+            r_adm = jnp.where(admissible, r, i32(0))
+            cum = jnp.cumsum(r_adm)
+            excl = cum - r_adm
+            prefix_before = excl - excl[s_segstart]
+            delta = jnp.clip(e_at - prefix_before, 0, r_adm)
+            new_flow = flow + arc_delta(delta)
+
+            pushed = _seg_sum_local(delta, node_first, node_last, node_nonempty)
+            sum_r = _seg_sum_local(r, node_first, node_last, node_nonempty)
+            cand = jnp.where(r > 0, p[s_dst] - s_cost, -_BIG)
+            scanned = _seg_scan(cand, s_isstart, jnp.maximum)
+            best = jnp.where(node_nonempty, scanned[node_last], -_BIG)
+            relabel = (excess > 0) & (pushed == 0) & (sum_r > 0) & owned
+            p_local = jnp.where(relabel, best - eps, jnp.where(owned, p, i32(0)))
+            new_p = lax.psum(jnp.where(owned, p_local, i32(0)), axis)
+            return new_flow, new_p
+
+        def sat_full(flow, p):
+            rc = s_cost + p[s_src] - p[s_dst]
+            r = residual(flow)
+            want = jnp.where((rc < 0) & s_valid & (s_sign > 0), cap[s_arc], i32(-1))
+            want = jnp.where((rc < 0) & s_valid & (s_sign < 0), i32(0), want)
+            # translate per-entry wishes to per-arc flow targets
+            wz = jnp.where(s_valid, want, i32(-1))
+            tgt_f = wz[pos_fwd]
+            tgt_b = wz[pos_bwd]
+            tgt = jnp.maximum(lax.pmax(tgt_f, axis), lax.pmax(tgt_b, axis))
+            return jnp.where(tgt >= 0, tgt, flow)
+
+        def phase_cond(state):
+            _flow, _p, _eps, steps, done = state
+            return ~done & (steps < step_cap)
+
+        def phase_body(state):
+            flow, p, eps, steps, done = state
+            excess = excess_of(flow)
+            any_active = jnp.any(excess > 0)
+
+            def do_superstep(_):
+                f2, p2 = superstep(flow, p, eps, excess)
+                return f2, p2, eps, steps + 1, jnp.bool_(False)
+
+            def next_phase(_):
+                finished = eps <= 1
+                new_eps = jnp.maximum(i32(1), eps // alpha)
+                f2 = jnp.where(finished, flow, sat_full(flow, p))
+                return f2, p, jnp.where(finished, eps, new_eps), steps, finished
+
+            return lax.cond(any_active, do_superstep, next_phase, operand=None)
+
+        p0 = tighten(flow0)
+        flow1 = sat_full(flow0, p0)
+        state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
+        flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
+        converged = done & (jnp.max(jnp.abs(excess_of(flow))) == 0)
+        p_overflow = jnp.max(jnp.abs(p)) >= (1 << 30)
+        return flow, steps, converged, p_overflow
+
+    in_specs = (
+        spec_repl, spec_repl, spec_repl, spec_repl, spec_repl, spec_repl,
+        spec_sharded, spec_sharded, spec_sharded, spec_sharded, spec_sharded,
+        spec_sharded, spec_sharded, spec_sharded, spec_sharded, spec_sharded,
+        spec_sharded, spec_sharded, spec_sharded,
+    )
+    out_specs = (spec_repl, spec_repl, spec_repl, spec_repl)
+    fn = shard_map(solve_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(fn)
+
+
+class ShardedJaxSolver(FlowSolver):
+    """Push-relabel MCMF sharded over a jax Mesh axis."""
+
+    def __init__(self, mesh: Mesh, axis: str = "x", alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True):
+        self.mesh = mesh
+        self.axis = axis
+        self.alpha = alpha
+        self.max_supersteps = max_supersteps
+        self.warm_start = warm_start
+        self._plan: Optional[ShardedPlan] = None
+        self._plan_dev = None
+        self._solve_fn = None
+        self._prev: Optional[np.ndarray] = None
+        self.last_supersteps = 0
+
+    def reset(self) -> None:
+        self._prev = None
+
+    @property
+    def num_shards(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names if a == self.axis]))
+
+    def solve(self, problem: FlowProblem) -> FlowResult:
+        n = problem.num_nodes
+        m = len(problem.src)
+        if m == 0 or problem.num_arcs == 0:
+            if (problem.excess > 0).any():
+                raise RuntimeError("infeasible flow problem: supply but no arcs")
+            return FlowResult(flow=np.zeros(m, dtype=np.int64), objective=0, iterations=0)
+        src = problem.src.astype(np.int32)
+        dst = problem.dst.astype(np.int32)
+        cap = problem.cap.astype(np.int32)
+        supply = problem.excess.astype(np.int32)
+        max_cost = int(np.abs(problem.cost).max()) if m else 0
+        if max_cost * n >= (1 << 30):
+            raise OverflowError("scaled costs overflow int32")
+        cost = problem.cost.astype(np.int32) * np.int32(n)
+
+        prev_plan = self._plan
+        plan = prev_plan
+        if plan is None or len(plan.src) != m or plan.node_first.shape[1] != n or not (
+            np.array_equal(plan.src, src) and np.array_equal(plan.dst, dst)
+        ):
+            plan = build_sharded_plan(src, dst, n, self.num_shards)
+            self._plan = plan
+            self._plan_dev = tuple(
+                jnp.asarray(x)
+                for x in (
+                    plan.s_arc, plan.s_sign, plan.s_src, plan.s_dst,
+                    plan.s_segstart, plan.s_isstart, plan.s_valid,
+                    plan.node_first, plan.node_last, plan.node_nonempty,
+                    plan.owned, plan.pos_fwd, plan.pos_bwd,
+                )
+            )
+            self._solve_fn = make_sharded_solver(
+                self.mesh, self.axis, self.alpha, self.max_supersteps
+            )
+
+        flow0 = np.zeros(m, dtype=np.int32)
+        if (
+            self.warm_start
+            and self._prev is not None
+            and len(self._prev) == m
+            and prev_plan is not None
+            and len(prev_plan.src) == m
+        ):
+            # Compare against the endpoints the previous flow was solved
+            # for (prev_plan), not the freshly rebuilt plan.
+            same = (prev_plan.src == src) & (prev_plan.dst == dst)
+            flow0 = np.where(same, np.minimum(self._prev, cap), 0).astype(np.int32)
+
+        attempts = [
+            (flow0, 1, min(4096, self.max_supersteps)),
+            (np.zeros(m, dtype=np.int32), max(1, max_cost * n), self.max_supersteps),
+        ]
+        flow = steps = None
+        converged = p_overflow = False
+        for f0, eps_init, cap_steps in attempts:
+            flow, steps, converged, p_overflow = self._solve_fn(
+                jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply),
+                jnp.asarray(f0), jnp.asarray(np.int32(eps_init)),
+                jnp.asarray(np.int32(cap_steps)),
+                *self._plan_dev,
+            )
+            if bool(converged) and not bool(p_overflow):
+                break
+        self.last_supersteps = int(steps)
+        if bool(p_overflow) or not bool(converged):
+            self._prev = None
+        if bool(p_overflow):
+            raise OverflowError("sharded push-relabel potentials approached int32 range")
+        if not bool(converged):
+            raise RuntimeError("sharded push-relabel did not converge; infeasible?")
+        flow_np = np.asarray(flow)
+        if self.warm_start:
+            self._prev = flow_np.astype(np.int32)
+        objective = int(
+            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+            + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+        )
+        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))

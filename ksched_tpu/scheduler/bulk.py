@@ -1,0 +1,346 @@
+"""Bulk array-native scheduling: the TPU fast path.
+
+The object/event layer (scheduler/flow_scheduler.py) mirrors the
+reference's per-task API; this module is the scale path the TPU rebuild
+exists for. Cluster state lives directly in flat numpy arrays (the same
+layout graph/device_export.py produces), task arrival/completion are
+bulk vector operations, and a scheduling round is a handful of numpy
+ops + one device solve + a vectorized decode — no per-task Python work.
+
+Graph shape (the quincy/trivial aggregate topology, reference:
+trivial_cost_modeler.go + graph_manager.go):
+
+    task --(cost u_j, cap 1)--> unsched_agg[job]  --(cap #tasks)--> sink
+    task --(cost e,  cap 1)--> EC hub
+    EC   --(cost c_m, cap free_m)--> machine_m
+    machine_m --(cap s, cost 0)--> PU --(cap s)--> sink
+
+Node-id layout (dense rows, row 0 reserved):
+    1 .. J                       unscheduled aggregators (one per job)
+    J+1                          EC hub
+    J+2 .. J+1+M                 machines
+    J+2+M .. J+1+M+M*P           PUs (P per machine)
+    J+2+M+M*P                    sink
+    task rows allocated/recycled after that.
+
+Per-machine costs (c_m) and per-job unscheduled costs let the CoCo /
+Whare-Map style policies drive the same structure; the cost arrays are
+supplied per round by a vectorized cost model callback.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graph.device_export import FlowProblem
+from ..solver.base import FlowSolver
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass
+class BulkRoundResult:
+    placed_tasks: np.ndarray  # task row ids newly placed this round
+    placed_pus: np.ndarray  # PU row each was placed on
+    preempted_tasks: np.ndarray  # task rows whose placement was revoked
+    num_unscheduled: int
+    timing: Dict[str, float] = field(default_factory=dict)
+
+
+class BulkCluster:
+    """Flat-array cluster state + vectorized scheduling rounds."""
+
+    def __init__(
+        self,
+        num_machines: int,
+        pus_per_machine: int,
+        slots_per_pu: int,
+        num_jobs: int,
+        backend: FlowSolver,
+        unsched_cost: int = 5,
+        ec_cost: int = 2,
+        machine_cost_fn: Optional[Callable[["BulkCluster"], np.ndarray]] = None,
+        task_capacity: int = 2_048,
+    ) -> None:
+        self.M = num_machines
+        self.P = pus_per_machine
+        self.S = slots_per_pu
+        self.J = num_jobs
+        self.backend = backend
+        self.unsched_cost = unsched_cost
+        self.ec_cost = ec_cost
+        self.machine_cost_fn = machine_cost_fn
+
+        self.unsched0 = 1
+        self.ec = 1 + num_jobs
+        self.machine0 = self.ec + 1
+        self.pu0 = self.machine0 + num_machines
+        self.num_pus = num_machines * pus_per_machine
+        self.sink = self.pu0 + self.num_pus
+        self.task0 = self.sink + 1
+
+        self.n_cap = _next_pow2(self.task0 + task_capacity)
+        self.task_cap = self.n_cap - self.task0
+
+        # Static arc slots: EC->machine (M), machine->PU (num_pus),
+        # PU->sink (num_pus), unsched->sink (J). Task arc slots follow,
+        # two per task row (-> unsched agg, -> EC).
+        self.a_ecm0 = 0
+        self.a_mpu0 = self.a_ecm0 + num_machines
+        self.a_pusink0 = self.a_mpu0 + self.num_pus
+        self.a_unsink0 = self.a_pusink0 + self.num_pus
+        self.a_task0 = self.a_unsink0 + num_jobs
+        self.m_cap = _next_pow2(self.a_task0 + 2 * self.task_cap)
+
+        self.src = np.zeros(self.m_cap, np.int32)
+        self.dst = np.zeros(self.m_cap, np.int32)
+        self.cap = np.zeros(self.m_cap, np.int32)
+        self.cost = np.zeros(self.m_cap, np.int32)
+        self.excess = np.zeros(self.n_cap, np.int64)
+        self.node_type = np.full(self.n_cap, -1, np.int8)
+
+        # Task bookkeeping (dense per task row, relative to task0).
+        # Rows are partitioned into per-job pools (row r belongs to job
+        # r % J) and every row's two arcs are pre-wired at init, so arc
+        # endpoints NEVER change: the solver's CSR plan is built once and
+        # reused for the lifetime of the cluster (the structure-churn
+        # killer for per-round host work).
+        self.task_live = np.zeros(self.task_cap, bool)
+        self.task_job = np.zeros(self.task_cap, np.int32)
+        self.task_pu = np.full(self.task_cap, -1, np.int32)  # PU row or -1
+        self.pu_running = np.zeros(self.num_pus, np.int32)
+        self._job_free: List[List[int]] = [
+            [r for r in range(self.task_cap - 1, -1, -1) if r % num_jobs == j]
+            for j in range(num_jobs)
+        ]
+
+        self._wire_static()
+
+    # ------------------------------------------------------------------
+
+    def _wire_static(self) -> None:
+        M, P, J = self.M, self.P, self.J
+        machines = np.arange(M, dtype=np.int32)
+        pus = np.arange(self.num_pus, dtype=np.int32)
+        jobs = np.arange(J, dtype=np.int32)
+
+        sl = slice(self.a_ecm0, self.a_ecm0 + M)
+        self.src[sl] = self.ec
+        self.dst[sl] = self.machine0 + machines
+        self.cap[sl] = 0  # refreshed per round from free slots
+        self.cost[sl] = 0
+
+        sl = slice(self.a_mpu0, self.a_mpu0 + self.num_pus)
+        self.src[sl] = self.machine0 + (pus // P)
+        self.dst[sl] = self.pu0 + pus
+        self.cap[sl] = self.S
+
+        sl = slice(self.a_pusink0, self.a_pusink0 + self.num_pus)
+        self.src[sl] = self.pu0 + pus
+        self.dst[sl] = self.sink
+        self.cap[sl] = self.S
+
+        sl = slice(self.a_unsink0, self.a_unsink0 + J)
+        self.src[sl] = self.unsched0 + jobs
+        self.dst[sl] = self.sink
+        self.cap[sl] = 0  # grows with live tasks per job
+
+        # Pre-wire every task row's arc endpoints (capacity 0 until the
+        # row is occupied); row r's job is r % J.
+        rows = np.arange(self.task_cap, dtype=np.int32)
+        abs_rows = self.task0 + rows
+        a0 = self.a_task0 + 2 * rows
+        self.src[a0] = abs_rows
+        self.dst[a0] = self.unsched0 + (rows % J)
+        self.src[a0 + 1] = abs_rows
+        self.dst[a0 + 1] = self.ec
+
+        from ..graph.flowgraph import NodeType
+
+        self.node_type[self.unsched0 : self.unsched0 + J] = int(NodeType.JOB_AGGREGATOR)
+        self.node_type[self.ec] = int(NodeType.EQUIV_CLASS)
+        self.node_type[self.machine0 : self.machine0 + M] = int(NodeType.MACHINE)
+        self.node_type[self.pu0 : self.pu0 + self.num_pus] = int(NodeType.PU)
+        self.node_type[self.sink] = int(NodeType.SINK)
+
+    # ------------------------------------------------------------------
+    # Bulk task lifecycle
+    # ------------------------------------------------------------------
+
+    def add_tasks(self, count: int, job_ids: Optional[np.ndarray] = None) -> np.ndarray:
+        """Admit `count` new tasks; returns their task rows (absolute ids)."""
+        if job_ids is None:
+            job_ids = np.zeros(count, np.int32)
+        rows = np.empty(count, dtype=np.int32)
+        for i, j in enumerate(job_ids):
+            pool = self._job_free[int(j)]
+            if not pool:
+                raise RuntimeError(
+                    f"task pool for job {int(j)} exhausted "
+                    f"(capacity {self.task_cap // self.J} rows per job)"
+                )
+            rows[i] = pool.pop()
+        abs_rows = self.task0 + rows
+        self.task_live[rows] = True
+        self.task_job[rows] = job_ids
+        self.task_pu[rows] = -1
+        self.excess[abs_rows] = 1
+        from ..graph.flowgraph import NodeType
+
+        self.node_type[abs_rows] = int(NodeType.UNSCHEDULED_TASK)
+        # Arc endpoints are pre-wired (row pools are per-job); only
+        # capacities and costs flip on.
+        a0 = self.a_task0 + 2 * rows
+        self.cap[a0] = 1
+        self.cost[a0] = self.unsched_cost
+        self.cap[a0 + 1] = 1
+        self.cost[a0 + 1] = self.ec_cost
+        # unsched agg capacity grows per live task
+        np.add.at(self.cap, self.a_unsink0 + job_ids, 1)
+        return abs_rows
+
+    def complete_tasks(self, abs_rows: np.ndarray) -> None:
+        """Retire tasks (vectorized TaskCompleted): free their slots and
+        remove their nodes/arcs."""
+        rows = abs_rows - self.task0
+        assert self.task_live[rows].all(), "completing a task that is not live"
+        on_pu = self.task_pu[rows]
+        placed = on_pu >= 0
+        if placed.any():
+            np.add.at(self.pu_running, on_pu[placed], -1)
+        # Placed tasks already gave back their unsched-agg capacity when
+        # they were pinned (see round()); only unplaced ones return it now.
+        if (~placed).any():
+            np.add.at(self.cap, self.a_unsink0 + self.task_job[rows[~placed]], -1)
+        self.task_live[rows] = False
+        self.task_pu[rows] = -1
+        self.excess[abs_rows] = 0
+        self.node_type[abs_rows] = -1
+        a0 = self.a_task0 + 2 * rows
+        for a in (a0, a0 + 1):
+            self.cap[a] = 0
+            self.cost[a] = 0
+        for r in rows:
+            self._job_free[int(r) % self.J].append(int(r))
+
+    # ------------------------------------------------------------------
+    # The scheduling round
+    # ------------------------------------------------------------------
+
+    def _refresh_capacities(self) -> None:
+        """Per-round stats + capacity refresh (the vectorized equivalent
+        of ComputeTopologyStatistics + updateEquivToResArcs)."""
+        pu_free = self.S - self.pu_running
+        machine_free = pu_free.reshape(self.M, self.P).sum(axis=1)
+        self.cap[self.a_ecm0 : self.a_ecm0 + self.M] = machine_free
+        # PU->sink and machine->PU capacity excludes running tasks
+        # (capacityFromResNodeToParent with preemption off,
+        # graph_manager.go:662-667).
+        self.cap[self.a_mpu0 : self.a_mpu0 + self.num_pus] = pu_free
+        self.cap[self.a_pusink0 : self.a_pusink0 + self.num_pus] = pu_free
+        if self.machine_cost_fn is not None:
+            self.cost[self.a_ecm0 : self.a_ecm0 + self.M] = self.machine_cost_fn(self)
+
+    def _problem(self) -> FlowProblem:
+        live = int(self.task_live.sum())
+        placed = int((self.task_pu >= 0)[self.task_live].sum())
+        self.excess[self.sink] = -(live - placed)
+        return FlowProblem(
+            num_nodes=self.n_cap,
+            excess=self.excess,
+            node_type=self.node_type,
+            src=self.src,
+            dst=self.dst,
+            cap=self.cap,
+            cost=self.cost,
+            flow_offset=np.zeros(self.m_cap, np.int32),
+            num_arcs=self.m_cap,
+        )
+
+    def round(self) -> BulkRoundResult:
+        timing: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        self._refresh_capacities()
+        # Placed tasks are pinned: zero their graph presence (their slot
+        # stays accounted via pu_running, mirroring pinTaskToNode +
+        # capacity accounting with preemption off).
+        timing["stats_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        problem = self._problem()
+        result = self.backend.solve(problem)
+        timing["solve_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        placed_tasks, placed_pus, num_unsched = self._decode(result.flow)
+        timing["decode_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        if len(placed_tasks):
+            rows = placed_tasks - self.task0
+            self.task_pu[rows] = placed_pus - self.pu0
+            np.add.at(self.pu_running, placed_pus - self.pu0, 1)
+            # pin: remove the placed tasks' supply and arcs from the
+            # flow problem; their slots are excluded via pu_running.
+            self.excess[placed_tasks] = 0
+            a0 = self.a_task0 + 2 * rows
+            self.cap[a0] = 0
+            self.cap[a0 + 1] = 0
+            np.add.at(self.cap, self.a_unsink0 + self.task_job[rows], -1)
+            from ..graph.flowgraph import NodeType
+
+            self.node_type[placed_tasks] = int(NodeType.SCHEDULED_TASK)
+        timing["apply_s"] = time.perf_counter() - t0
+        return BulkRoundResult(
+            placed_tasks=placed_tasks,
+            placed_pus=placed_pus,
+            preempted_tasks=np.empty(0, np.int32),
+            num_unscheduled=num_unsched,
+            timing=timing,
+        )
+
+    def _decode(self, flow: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Vectorized flow decomposition for the EC-hub topology: any
+        bijection between EC inflow units and EC outflow units is a valid
+        decomposition (the EC is a single hub), as is rank-matching
+        machine units to PU units."""
+        rows = np.nonzero(self.task_live & (self.task_pu < 0))[0]
+        a_ec = self.a_task0 + 2 * rows + 1
+        placed_mask = flow[a_ec] > 0
+        placed_rows = rows[placed_mask]
+
+        ecm = flow[self.a_ecm0 : self.a_ecm0 + self.M].astype(np.int64)
+        mpu = flow[self.a_mpu0 : self.a_mpu0 + self.num_pus].astype(np.int64)
+        assert ecm.sum() == len(placed_rows), (
+            f"EC outflow {ecm.sum()} != placed tasks {len(placed_rows)}"
+        )
+        assert mpu.sum() == ecm.sum(), "machine->PU flow mismatch"
+        # PU grants expanded in PU (machine-major) order and placed tasks
+        # expanded against EC->machine counts line up rank-for-rank: both
+        # sequences enumerate the same per-machine unit multiset in
+        # nondecreasing machine order (flow conservation at each machine
+        # gives ecm[m] == sum of its mpu), so index-wise pairing is a
+        # valid decomposition of the flow.
+        pu_grants = np.repeat(np.arange(self.num_pus, dtype=np.int32), mpu)
+        pus_for_tasks = (self.pu0 + pu_grants).astype(np.int32)
+        num_unsched = int(self.task_live.sum() - (self.task_pu >= 0).sum() - len(placed_rows))
+        return (self.task0 + placed_rows).astype(np.int32), pus_for_tasks, num_unsched
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_live_tasks(self) -> int:
+        return int(self.task_live.sum())
+
+    @property
+    def num_placed_tasks(self) -> int:
+        return int((self.task_pu >= 0).sum())

@@ -34,9 +34,15 @@ TPU-shaped implementation notes:
   DeviceGraphState), so repeated rounds reuse one compiled executable.
 
 Incremental warm start (the property Flowlessly's daemon mode provides):
-potentials and flows from the previous round are reused; flows on arc
-slots whose endpoints changed are dropped, and remaining eps-optimality
-violations define the starting eps — so re-solve cost tracks the delta.
+the previous round's flow is carried over (dropped on arc slots whose
+endpoints changed), and a price-tightening pass — synchronous
+Bellman-Ford over residual reduced costs, a handful of sweeps for these
+shallow graphs — re-derives consistent potentials before every solve.
+That removes cross-round potential drift entirely (stale prices after
+capacity changes otherwise blow up relabel chains), lets the discharge
+run at eps=1 (exact, since costs are pre-scaled by the node count), and
+makes re-solve cost track the delta. Cost-scaling from max-cost remains
+as a fallback when the eps=1 attempt exceeds its superstep budget.
 """
 
 from __future__ import annotations
@@ -131,13 +137,29 @@ def _seg_max(vals, isstart, node_last, node_nonempty, identity):
     return jnp.where(node_nonempty, scanned[node_last], identity)
 
 
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
+def _seg_min(vals, isstart, node_last, node_nonempty, identity):
+    """Per-node min via a segmented-min associative scan."""
+
+    def combine(a, b):
+        f1, v1 = a
+        f2, v2 = b
+        return f1 | f2, jnp.where(f2, v2, jnp.minimum(v1, v2))
+
+    _, scanned = lax.associative_scan(combine, (isstart, vals))
+    return jnp.where(node_nonempty, scanned[node_last], identity)
+
+
+_BIG_D = 1 << 28  # "unreachable" distance sentinel for price tightening
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps"))
 def _solve_mcmf(
-    cap, cost, supply, p0, flow0, eps_init,
+    cap, cost, supply, flow0, eps_init,
     s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, inv_order,
     node_first, node_last, node_nonempty,
     alpha: int = 8,
     max_supersteps: int = 50_000,
+    tighten_sweeps: int = 32,
 ):
     m = cap.shape[0]
     i32 = jnp.int32
@@ -158,6 +180,36 @@ def _solve_mcmf(
     fwd_pos = inv_order[:m]
     cap_src = s_src[fwd_pos]
     cap_dst = s_dst[fwd_pos]
+
+    def tighten(flow):
+        """Price tightening: p = -(shortest residual-cost distance to a
+        demand node), via synchronous Bellman-Ford sweeps over the sorted
+        entries. Afterwards every residual arc between reachable nodes
+        has nonnegative reduced cost, so the discharge can run at eps=1
+        regardless of how flows/capacities changed since the last round —
+        this is what makes warm restarts cheap and drift-free."""
+        excess0 = excess_of(flow)
+        a_flow = flow[s_arc]
+        r = jnp.where(s_sign > 0, cap[s_arc] - a_flow, a_flow)
+        s_cost = s_sign * cost[s_arc]
+        d0 = jnp.where(excess0 < 0, i32(0), i32(_BIG_D))
+
+        def t_cond(state):
+            _d, changed, it = state
+            return changed & (it < tighten_sweeps)
+
+        def t_body(state):
+            d, _, it = state
+            cand = jnp.where(r > 0, s_cost + d[s_dst], i32(_BIG_D))
+            best = _seg_min(cand, s_isstart, node_last, node_nonempty, i32(_BIG_D))
+            # Clamp from below: a negative-cost residual cycle (possible
+            # transiently with warm flows + changed costs) must not run d
+            # toward int32 wraparound; the discharge handles the rest.
+            d2 = jnp.maximum(jnp.minimum(d, best), -i32(_BIG_D))
+            return d2, jnp.any(d2 != d), it + 1
+
+        d, _, _ = lax.while_loop(t_cond, t_body, (d0, jnp.bool_(True), i32(0)))
+        return -jnp.minimum(d, i32(_BIG_D))
 
     def superstep(flow, p, eps, excess):
         a_flow = flow[s_arc]
@@ -209,7 +261,8 @@ def _solve_mcmf(
 
         return lax.cond(any_active, do_superstep, next_phase, operand=None)
 
-    flow1 = saturate(flow0, p0)  # establish eps_init-optimality
+    p0 = tighten(flow0)
+    flow1 = saturate(flow0, p0)  # mop up any residual violations
     state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
     flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
     converged = done & (jnp.max(jnp.abs(excess_of(flow))) == 0)
@@ -224,7 +277,7 @@ class JaxSolver(FlowSolver):
         self.alpha = alpha
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
-        self._prev: Optional[Tuple[np.ndarray, np.ndarray]] = None  # (p, flow)
+        self._prev: Optional[np.ndarray] = None  # previous round's flow
         self._plan: Optional[CsrPlan] = None
         self._plan_dev: Optional[tuple] = None
         self.last_supersteps = 0
@@ -276,49 +329,42 @@ class JaxSolver(FlowSolver):
         prev_plan = self._plan
         plan_dev = self._plan_for(src, dst, n)
 
-        p0 = np.zeros(n, dtype=np.int32)
         flow0 = np.zeros(m, dtype=np.int32)
-        warm = False
         if self.warm_start and self._prev is not None:
-            p_prev, f_prev = self._prev
-            if len(p_prev) == n and len(f_prev) == m and prev_plan is not None:
-                warm = True
-                p0 = p_prev
+            f_prev = self._prev
+            if len(f_prev) == m and prev_plan is not None and len(prev_plan.src) == m:
+                # Reuse prior flow where the arc endpoints are unchanged;
+                # price tightening inside the solve re-derives consistent
+                # potentials, so flow is the only warm state needed.
                 same = (prev_plan.src == src) & (prev_plan.dst == dst)
                 flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
 
-        if warm:
-            # Start eps at the largest eps-optimality violation of the
-            # carried-over state: re-solve cost tracks the delta size.
-            rc = cost.astype(np.int64) + p0[src].astype(np.int64) - p0[dst].astype(np.int64)
-            viol = 0
-            fwd_live = cap > flow0
-            if fwd_live.any():
-                viol = max(viol, int(np.max(-rc[fwd_live])))
-            bwd_live = flow0 > 0
-            if bwd_live.any():
-                viol = max(viol, int(np.max(rc[bwd_live])))
-            eps_init = max(1, viol)
-        else:
-            eps_init = max(1, max_cost * n)
-
-        flow, p, steps, converged, p_overflow = _solve_mcmf(
-            jnp.asarray(cap),
-            jnp.asarray(cost),
-            jnp.asarray(supply),
-            jnp.asarray(p0),
-            jnp.asarray(flow0),
-            jnp.asarray(np.int32(eps_init)),
-            *plan_dev,
-            alpha=self.alpha,
-            max_supersteps=self.max_supersteps,
-        )
-        if warm and (not bool(converged) or bool(p_overflow)):
-            # Warm start led the search astray (e.g. a large structural
-            # delta): retry cold rather than failing the round.
-            self._prev = None
-            return self.solve(problem)
+        # Attempt 1: warm flow, tightened prices + eps=1 discharge
+        # (cheap, exact, and in practice a handful of supersteps per
+        # delta). Attempt 2: genuinely cold — zero flow and full
+        # cost-scaling — so a poisoned warm state can always recover.
+        attempts = [
+            (flow0, 1, min(4096, self.max_supersteps)),
+            (np.zeros(m, dtype=np.int32), max(1, max_cost * n), self.max_supersteps),
+        ]
+        flow = p = steps = None
+        converged = p_overflow = False
+        for f0, eps_init, cap_steps in attempts:
+            flow, p, steps, converged, p_overflow = _solve_mcmf(
+                jnp.asarray(cap),
+                jnp.asarray(cost),
+                jnp.asarray(supply),
+                jnp.asarray(f0),
+                jnp.asarray(np.int32(eps_init)),
+                *plan_dev,
+                alpha=self.alpha,
+                max_supersteps=cap_steps,
+            )
+            if bool(converged) and not bool(p_overflow):
+                break
         self.last_supersteps = int(steps)
+        if bool(p_overflow) or not bool(converged):
+            self._prev = None  # never reuse the state that failed
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
@@ -328,7 +374,7 @@ class JaxSolver(FlowSolver):
             )
         flow_np = np.asarray(flow)
         if self.warm_start:
-            self._prev = (np.asarray(p), flow_np)
+            self._prev = flow_np.astype(np.int32)
         objective = int(
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
             + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()

@@ -9,6 +9,7 @@ from .ids import (
     seed_rng,
 )
 from .maps import JobMap, ResourceMap, ResourceStatus, TaskMap
+from .platform import force_cpu_platform
 
 __all__ = [
     "IDGenerator",
@@ -23,4 +24,5 @@ __all__ = [
     "ResourceMap",
     "ResourceStatus",
     "TaskMap",
+    "force_cpu_platform",
 ]

@@ -1,0 +1,21 @@
+"""JAX platform hygiene.
+
+The container's sitecustomize registers a tunneled-TPU PJRT plugin at
+interpreter boot; when the tunnel is down, merely initializing that
+backend hangs forever — even under JAX_PLATFORMS=cpu, because jax may
+have been imported (capturing the ambient platform list) before the
+caller could override it. This helper forces a clean CPU-only backend
+set; it must run before the first jax backend is materialized.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_platform() -> None:
+    import jax
+    import jax._src.xla_bridge as xb
+
+    jax.config.update("jax_platforms", "cpu")
+    for plat in list(getattr(xb, "_backend_factories", {})):
+        if plat != "cpu":
+            xb._backend_factories.pop(plat, None)

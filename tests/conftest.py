@@ -14,19 +14,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
-# The container's sitecustomize registers a tunneled TPU PJRT plugin at
-# interpreter boot; if the tunnel is down, merely *initializing* that
-# backend hangs — even under JAX_PLATFORMS=cpu. Deregister it before any
-# backend is materialized so tests are hermetic.
-import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
+# Deregister the tunneled-TPU backend before any backend materializes so
+# tests are hermetic even when the tunnel is down (see utils/platform.py).
+from ksched_tpu.utils import force_cpu_platform  # noqa: E402
 
-# jax may already have been imported by a pytest plugin before this
-# conftest ran, capturing the ambient JAX_PLATFORMS; override directly.
-jax.config.update("jax_platforms", "cpu")
-for _plat in list(getattr(_xb, "_backend_factories", {})):
-    if _plat != "cpu":
-        _xb._backend_factories.pop(_plat, None)
+force_cpu_platform()
 
 import pytest  # noqa: E402
 
