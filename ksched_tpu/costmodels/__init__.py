@@ -1,10 +1,22 @@
 from .base import CLUSTER_AGGREGATOR_EC, Cost, CostModeler, CostModelType
+from .census import CLASS_ECS, NUM_TASK_CLASSES, ClassCensusKeeper, class_ec, ec_class
+from .coco import CocoCostModel, coco_cost_matrix
 from .trivial import TrivialCostModel
+from .whare import WhareMapCostModel, whare_cost_matrix
 
 __all__ = [
     "CLUSTER_AGGREGATOR_EC",
+    "CLASS_ECS",
+    "NUM_TASK_CLASSES",
+    "ClassCensusKeeper",
+    "class_ec",
+    "ec_class",
     "Cost",
     "CostModeler",
     "CostModelType",
+    "CocoCostModel",
+    "coco_cost_matrix",
     "TrivialCostModel",
+    "WhareMapCostModel",
+    "whare_cost_matrix",
 ]

@@ -7,25 +7,30 @@ layout graph/device_export.py produces), task arrival/completion are
 bulk vector operations, and a scheduling round is a handful of numpy
 ops + one device solve + a vectorized decode — no per-task Python work.
 
-Graph shape (the quincy/trivial aggregate topology, reference:
-trivial_cost_modeler.go + graph_manager.go):
+Graph shape (the quincy-style aggregate topology, reference:
+trivial_cost_modeler.go + graph_manager.go), generalized to C task
+classes (C=1 for the trivial model; C=4 Sheep/Rabbit/Devil/Turtle for
+CoCo / Whare-Map, task_desc.proto:25-30):
 
-    task --(cost u_j, cap 1)--> unsched_agg[job]  --(cap #tasks)--> sink
-    task --(cost e,  cap 1)--> EC hub
-    EC   --(cost c_m, cap free_m)--> machine_m
+    task --(cost u, cap 1)--> unsched_agg[job]   --(cap #tasks)--> sink
+    task --(cost e, cap 1)--> EC[class(task)]
+    EC[c] --(cost cost[c,m], cap free_m)--> machine_m
     machine_m --(cap s, cost 0)--> PU --(cap s)--> sink
 
 Node-id layout (dense rows, row 0 reserved):
     1 .. J                       unscheduled aggregators (one per job)
-    J+1                          EC hub
-    J+2 .. J+1+M                 machines
-    J+2+M .. J+1+M+M*P           PUs (P per machine)
-    J+2+M+M*P                    sink
+    J+1 .. J+C                   class ECs
+    J+C+1 .. J+C+M               machines
+    J+C+M+1 .. +M*P              PUs (P per machine)
+    next                         sink
     task rows allocated/recycled after that.
 
-Per-machine costs (c_m) and per-job unscheduled costs let the CoCo /
-Whare-Map style policies drive the same structure; the cost arrays are
-supplied per round by a vectorized cost model callback.
+Every task row is pre-wired with 1+C arcs (unsched + one per class EC)
+so arc ENDPOINTS never change as rows are recycled across classes and
+jobs — the solver's CSR plan is built exactly once per cluster. Only
+capacities/costs flip. Per-round costs come from a vectorized cost-model
+callback (`class_cost_fn`): census [M, C] -> cost matrix [C, M] — e.g.
+costmodels.coco.coco_cost_matrix / costmodels.whare.whare_cost_matrix.
 """
 
 from __future__ import annotations
@@ -69,20 +74,25 @@ class BulkCluster:
         unsched_cost: int = 5,
         ec_cost: int = 2,
         machine_cost_fn: Optional[Callable[["BulkCluster"], np.ndarray]] = None,
+        class_cost_fn: Optional[Callable[["BulkCluster"], np.ndarray]] = None,
+        num_task_classes: int = 1,
         task_capacity: int = 2_048,
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
         self.S = slots_per_pu
         self.J = num_jobs
+        self.C = num_task_classes
         self.backend = backend
         self.unsched_cost = unsched_cost
         self.ec_cost = ec_cost
         self.machine_cost_fn = machine_cost_fn
+        self.class_cost_fn = class_cost_fn
 
+        C = self.C
         self.unsched0 = 1
-        self.ec = 1 + num_jobs
-        self.machine0 = self.ec + 1
+        self.ec0 = 1 + num_jobs
+        self.machine0 = self.ec0 + C
         self.pu0 = self.machine0 + num_machines
         self.num_pus = num_machines * pus_per_machine
         self.sink = self.pu0 + self.num_pus
@@ -91,15 +101,16 @@ class BulkCluster:
         self.n_cap = _next_pow2(self.task0 + task_capacity)
         self.task_cap = self.n_cap - self.task0
 
-        # Static arc slots: EC->machine (M), machine->PU (num_pus),
-        # PU->sink (num_pus), unsched->sink (J). Task arc slots follow,
-        # two per task row (-> unsched agg, -> EC).
+        # Static arc slots: EC->machine (C*M, class-major), machine->PU
+        # (num_pus), PU->sink (num_pus), unsched->sink (J). Task arc
+        # slots follow, 1+C per task row (-> unsched agg, -> each EC).
         self.a_ecm0 = 0
-        self.a_mpu0 = self.a_ecm0 + num_machines
+        self.a_mpu0 = self.a_ecm0 + C * num_machines
         self.a_pusink0 = self.a_mpu0 + self.num_pus
         self.a_unsink0 = self.a_pusink0 + self.num_pus
         self.a_task0 = self.a_unsink0 + num_jobs
-        self.m_cap = _next_pow2(self.a_task0 + 2 * self.task_cap)
+        self.arcs_per_task = 1 + C
+        self.m_cap = _next_pow2(self.a_task0 + self.arcs_per_task * self.task_cap)
 
         self.src = np.zeros(self.m_cap, np.int32)
         self.dst = np.zeros(self.m_cap, np.int32)
@@ -110,14 +121,18 @@ class BulkCluster:
 
         # Task bookkeeping (dense per task row, relative to task0).
         # Rows are partitioned into per-job pools (row r belongs to job
-        # r % J) and every row's two arcs are pre-wired at init, so arc
+        # r % J) and every row's arcs are pre-wired at init, so arc
         # endpoints NEVER change: the solver's CSR plan is built once and
         # reused for the lifetime of the cluster (the structure-churn
         # killer for per-round host work).
         self.task_live = np.zeros(self.task_cap, bool)
         self.task_job = np.zeros(self.task_cap, np.int32)
+        self.task_class = np.zeros(self.task_cap, np.int32)
         self.task_pu = np.full(self.task_cap, -1, np.int32)  # PU row or -1
         self.pu_running = np.zeros(self.num_pus, np.int32)
+        # Per-machine running-class census [M, C] — the vectorized
+        # WhareMapStats (whare_map_stats.proto:12-18).
+        self.machine_census = np.zeros((num_machines, C), np.int64)
         self._job_free: List[List[int]] = [
             [r for r in range(self.task_cap - 1, -1, -1) if r % num_jobs == j]
             for j in range(num_jobs)
@@ -128,16 +143,18 @@ class BulkCluster:
     # ------------------------------------------------------------------
 
     def _wire_static(self) -> None:
-        M, P, J = self.M, self.P, self.J
+        M, P, J, C = self.M, self.P, self.J, self.C
         machines = np.arange(M, dtype=np.int32)
         pus = np.arange(self.num_pus, dtype=np.int32)
         jobs = np.arange(J, dtype=np.int32)
 
-        sl = slice(self.a_ecm0, self.a_ecm0 + M)
-        self.src[sl] = self.ec
-        self.dst[sl] = self.machine0 + machines
-        self.cap[sl] = 0  # refreshed per round from free slots
-        self.cost[sl] = 0
+        # EC[c] -> machine arcs, class-major: arc a_ecm0 + c*M + m.
+        for c in range(C):
+            sl = slice(self.a_ecm0 + c * M, self.a_ecm0 + (c + 1) * M)
+            self.src[sl] = self.ec0 + c
+            self.dst[sl] = self.machine0 + machines
+            self.cap[sl] = 0  # refreshed per round from free slots
+            self.cost[sl] = 0
 
         sl = slice(self.a_mpu0, self.a_mpu0 + self.num_pus)
         self.src[sl] = self.machine0 + (pus // P)
@@ -155,19 +172,21 @@ class BulkCluster:
         self.cap[sl] = 0  # grows with live tasks per job
 
         # Pre-wire every task row's arc endpoints (capacity 0 until the
-        # row is occupied); row r's job is r % J.
+        # row is occupied); row r's job is r % J. Arc layout per row:
+        # [0] -> unsched agg, [1+c] -> EC c.
         rows = np.arange(self.task_cap, dtype=np.int32)
         abs_rows = self.task0 + rows
-        a0 = self.a_task0 + 2 * rows
+        a0 = self.a_task0 + self.arcs_per_task * rows
         self.src[a0] = abs_rows
         self.dst[a0] = self.unsched0 + (rows % J)
-        self.src[a0 + 1] = abs_rows
-        self.dst[a0 + 1] = self.ec
+        for c in range(C):
+            self.src[a0 + 1 + c] = abs_rows
+            self.dst[a0 + 1 + c] = self.ec0 + c
 
         from ..graph.flowgraph import NodeType
 
         self.node_type[self.unsched0 : self.unsched0 + J] = int(NodeType.JOB_AGGREGATOR)
-        self.node_type[self.ec] = int(NodeType.EQUIV_CLASS)
+        self.node_type[self.ec0 : self.ec0 + C] = int(NodeType.EQUIV_CLASS)
         self.node_type[self.machine0 : self.machine0 + M] = int(NodeType.MACHINE)
         self.node_type[self.pu0 : self.pu0 + self.num_pus] = int(NodeType.PU)
         self.node_type[self.sink] = int(NodeType.SINK)
@@ -176,10 +195,23 @@ class BulkCluster:
     # Bulk task lifecycle
     # ------------------------------------------------------------------
 
-    def add_tasks(self, count: int, job_ids: Optional[np.ndarray] = None) -> np.ndarray:
+    def add_tasks(
+        self,
+        count: int,
+        job_ids: Optional[np.ndarray] = None,
+        classes: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
         """Admit `count` new tasks; returns their task rows (absolute ids)."""
         if job_ids is None:
             job_ids = np.zeros(count, np.int32)
+        if classes is None:
+            classes = np.zeros(count, np.int32)
+        else:
+            classes = np.asarray(classes, np.int32)
+            if ((classes < 0) | (classes >= self.C)).any():
+                raise ValueError(
+                    f"task class out of range [0, {self.C}): {classes.min()}..{classes.max()}"
+                )
         rows = np.empty(count, dtype=np.int32)
         for i, j in enumerate(job_ids):
             pool = self._job_free[int(j)]
@@ -192,18 +224,21 @@ class BulkCluster:
         abs_rows = self.task0 + rows
         self.task_live[rows] = True
         self.task_job[rows] = job_ids
+        self.task_class[rows] = classes
         self.task_pu[rows] = -1
         self.excess[abs_rows] = 1
         from ..graph.flowgraph import NodeType
 
         self.node_type[abs_rows] = int(NodeType.UNSCHEDULED_TASK)
         # Arc endpoints are pre-wired (row pools are per-job); only
-        # capacities and costs flip on.
-        a0 = self.a_task0 + 2 * rows
+        # capacities and costs flip on — unsched arc plus the arc to the
+        # task's OWN class EC.
+        a0 = self.a_task0 + self.arcs_per_task * rows
         self.cap[a0] = 1
         self.cost[a0] = self.unsched_cost
-        self.cap[a0 + 1] = 1
-        self.cost[a0 + 1] = self.ec_cost
+        a_cls = a0 + 1 + classes
+        self.cap[a_cls] = 1
+        self.cost[a_cls] = self.ec_cost
         # unsched agg capacity grows per live task
         np.add.at(self.cap, self.a_unsink0 + job_ids, 1)
         return abs_rows
@@ -217,6 +252,11 @@ class BulkCluster:
         placed = on_pu >= 0
         if placed.any():
             np.add.at(self.pu_running, on_pu[placed], -1)
+            np.add.at(
+                self.machine_census,
+                (on_pu[placed] // self.P, self.task_class[rows[placed]]),
+                -1,
+            )
         # Placed tasks already gave back their unsched-agg capacity when
         # they were pinned (see round()); only unplaced ones return it now.
         if (~placed).any():
@@ -225,10 +265,10 @@ class BulkCluster:
         self.task_pu[rows] = -1
         self.excess[abs_rows] = 0
         self.node_type[abs_rows] = -1
-        a0 = self.a_task0 + 2 * rows
-        for a in (a0, a0 + 1):
-            self.cap[a] = 0
-            self.cost[a] = 0
+        a0 = self.a_task0 + self.arcs_per_task * rows
+        for k in range(self.arcs_per_task):
+            self.cap[a0 + k] = 0
+            self.cost[a0 + k] = 0
         for r in rows:
             self._job_free[int(r) % self.J].append(int(r))
 
@@ -239,16 +279,24 @@ class BulkCluster:
     def _refresh_capacities(self) -> None:
         """Per-round stats + capacity refresh (the vectorized equivalent
         of ComputeTopologyStatistics + updateEquivToResArcs)."""
+        M, C = self.M, self.C
         pu_free = self.S - self.pu_running
-        machine_free = pu_free.reshape(self.M, self.P).sum(axis=1)
-        self.cap[self.a_ecm0 : self.a_ecm0 + self.M] = machine_free
+        machine_free = pu_free.reshape(M, self.P).sum(axis=1)
+        # Every class EC offers each machine its full free capacity; the
+        # machine node's outgoing arcs bottleneck the aggregate.
+        self.cap[self.a_ecm0 : self.a_ecm0 + C * M] = np.tile(machine_free, C)
         # PU->sink and machine->PU capacity excludes running tasks
         # (capacityFromResNodeToParent with preemption off,
         # graph_manager.go:662-667).
         self.cap[self.a_mpu0 : self.a_mpu0 + self.num_pus] = pu_free
         self.cap[self.a_pusink0 : self.a_pusink0 + self.num_pus] = pu_free
-        if self.machine_cost_fn is not None:
-            self.cost[self.a_ecm0 : self.a_ecm0 + self.M] = self.machine_cost_fn(self)
+        if self.class_cost_fn is not None:
+            cost_cm = np.asarray(self.class_cost_fn(self), dtype=np.int32)
+            assert cost_cm.shape == (C, M), f"class_cost_fn must return [C={C}, M={M}]"
+            self.cost[self.a_ecm0 : self.a_ecm0 + C * M] = cost_cm.reshape(-1)
+        elif self.machine_cost_fn is not None:
+            cost_m = np.asarray(self.machine_cost_fn(self), dtype=np.int32)
+            self.cost[self.a_ecm0 : self.a_ecm0 + C * M] = np.tile(cost_m, C)
 
     def _problem(self) -> FlowProblem:
         live = int(self.task_live.sum())
@@ -289,12 +337,17 @@ class BulkCluster:
             rows = placed_tasks - self.task0
             self.task_pu[rows] = placed_pus - self.pu0
             np.add.at(self.pu_running, placed_pus - self.pu0, 1)
+            np.add.at(
+                self.machine_census,
+                ((placed_pus - self.pu0) // self.P, self.task_class[rows]),
+                1,
+            )
             # pin: remove the placed tasks' supply and arcs from the
             # flow problem; their slots are excluded via pu_running.
             self.excess[placed_tasks] = 0
-            a0 = self.a_task0 + 2 * rows
+            a0 = self.a_task0 + self.arcs_per_task * rows
             self.cap[a0] = 0
-            self.cap[a0 + 1] = 0
+            self.cap[a0 + 1 + self.task_class[rows]] = 0
             np.add.at(self.cap, self.a_unsink0 + self.task_job[rows], -1)
             from ..graph.flowgraph import NodeType
 
@@ -309,29 +362,41 @@ class BulkCluster:
         )
 
     def _decode(self, flow: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
-        """Vectorized flow decomposition for the EC-hub topology: any
-        bijection between EC inflow units and EC outflow units is a valid
-        decomposition (the EC is a single hub), as is rank-matching
-        machine units to PU units."""
+        """Vectorized flow decomposition for the class-EC topology: each
+        EC is a single hub, so any bijection between its inflow units
+        (tasks of that class) and its outflow units (EC->machine flows)
+        is a valid decomposition; likewise rank-matching machine inflow
+        units to PU grants within each machine."""
+        M, C = self.M, self.C
         rows = np.nonzero(self.task_live & (self.task_pu < 0))[0]
-        a_ec = self.a_task0 + 2 * rows + 1
-        placed_mask = flow[a_ec] > 0
+        a_cls = self.a_task0 + self.arcs_per_task * rows + 1 + self.task_class[rows]
+        placed_mask = flow[a_cls] > 0
         placed_rows = rows[placed_mask]
+        cls_of_placed = self.task_class[placed_rows]
 
-        ecm = flow[self.a_ecm0 : self.a_ecm0 + self.M].astype(np.int64)
+        ecm = flow[self.a_ecm0 : self.a_ecm0 + C * M].astype(np.int64).reshape(C, M)
         mpu = flow[self.a_mpu0 : self.a_mpu0 + self.num_pus].astype(np.int64)
         assert ecm.sum() == len(placed_rows), (
             f"EC outflow {ecm.sum()} != placed tasks {len(placed_rows)}"
         )
         assert mpu.sum() == ecm.sum(), "machine->PU flow mismatch"
-        # PU grants expanded in PU (machine-major) order and placed tasks
-        # expanded against EC->machine counts line up rank-for-rank: both
-        # sequences enumerate the same per-machine unit multiset in
-        # nondecreasing machine order (flow conservation at each machine
-        # gives ecm[m] == sum of its mpu), so index-wise pairing is a
-        # valid decomposition of the flow.
-        pu_grants = np.repeat(np.arange(self.num_pus, dtype=np.int32), mpu)
-        pus_for_tasks = (self.pu0 + pu_grants).astype(np.int32)
+
+        # Stage 1 — task -> machine, per class: tasks of class c (row
+        # order) pair rank-for-rank with repeat(machines, ecm[c]) (flow
+        # conservation at EC c makes the counts equal).
+        machine_of_task = np.empty(len(placed_rows), dtype=np.int64)
+        for c in range(C):
+            sel = cls_of_placed == c
+            machine_of_task[sel] = np.repeat(np.arange(M, dtype=np.int64), ecm[c])
+        # Stage 2 — machine -> PU: total machine inflow equals its PU
+        # outflow; expand PU grants machine-major and pair them with the
+        # placed tasks sorted (stably) by machine. Any within-machine
+        # bijection is a valid decomposition.
+        pu_grants = np.repeat(np.arange(self.num_pus, dtype=np.int64), mpu)
+        order = np.argsort(machine_of_task, kind="stable")
+        pus_for_tasks = np.empty(len(placed_rows), dtype=np.int32)
+        pus_for_tasks[order] = (self.pu0 + pu_grants).astype(np.int32)
+
         num_unsched = int(self.task_live.sum() - (self.task_pu >= 0).sum() - len(placed_rows))
         return (self.task0 + placed_rows).astype(np.int32), pus_for_tasks, num_unsched
 
