@@ -1,11 +1,13 @@
 from .base import FlowResult, FlowSolver
 from .cpu_ref import ReferenceSolver
 from .decode import flow_to_mapping
+from .native import NativeSolver
 from .placement import PlacementSolver
 
 __all__ = [
     "FlowResult",
     "FlowSolver",
+    "NativeSolver",
     "ReferenceSolver",
     "flow_to_mapping",
     "PlacementSolver",

@@ -41,6 +41,14 @@ class FlowResult:
         return self.flow + problem.flow_offset
 
 
+def lower_bound_cost(problem: FlowProblem) -> int:
+    """Cost carried by the folded lower-bound flow; every backend adds
+    this to its solved objective so objectives are comparable."""
+    return int(
+        (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+    )
+
+
 class FlowSolver(abc.ABC):
     """A min-cost max-flow backend over flat arrays."""
 

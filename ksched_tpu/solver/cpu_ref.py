@@ -19,7 +19,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver
+from .base import FlowResult, FlowSolver, lower_bound_cost
 
 _INF = float("inf")
 
@@ -91,7 +91,7 @@ class ReferenceSolver(FlowSolver):
             iterations += 1
             supplies = [v for v in supplies if excess[v] > 0]
 
-        objective = int((flow * cost).sum() + (problem.flow_offset.astype(np.int64) * cost).sum())
+        objective = int((flow * cost).sum()) + lower_bound_cost(problem)
         return FlowResult(flow=flow, objective=objective, iterations=iterations)
 
     @staticmethod

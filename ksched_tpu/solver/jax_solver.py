@@ -57,7 +57,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver
+from .base import FlowResult, FlowSolver, lower_bound_cost
 
 _BIG = jnp.int32(1 << 30)
 _P_GUARD = 1 << 30  # potential magnitude beyond this risks int32 overflow
@@ -377,6 +377,5 @@ class JaxSolver(FlowSolver):
             self._prev = flow_np.astype(np.int32)
         objective = int(
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
-            + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()
-        )
+        ) + lower_bound_cost(problem)
         return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))
