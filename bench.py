@@ -43,11 +43,31 @@ def _accelerator_alive(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _solver_work(backend) -> int:
+    """Iterations/supersteps the backend spent on its last solve."""
+    return getattr(backend, "last_supersteps", None) or getattr(backend, "last_iterations", 0)
+
+
 def build(args):
     from ksched_tpu.scheduler.bulk import BulkCluster
-    from ksched_tpu.solver.jax_solver import JaxSolver
 
-    backend = JaxSolver(warm_start=not args.cold)
+    if args.backend == "auto":
+        # Platform-appropriate production backend: the JAX push-relabel on
+        # an accelerator; the native C++ library when running host-only
+        # (the same pairing the reference has with Flowlessly on CPU).
+        args.backend = "jax" if not args.cpu else "native"
+    if args.backend == "jax":
+        from ksched_tpu.solver.jax_solver import JaxSolver
+
+        backend = JaxSolver(warm_start=not args.cold)
+    elif args.backend == "native":
+        from ksched_tpu.solver.native import NativeSolver
+
+        backend = NativeSolver(algorithm="cost_scaling", warm_start=not args.cold)
+    else:
+        from ksched_tpu.solver.cpu_ref import ReferenceSolver
+
+        backend = ReferenceSolver()
     cluster = BulkCluster(
         num_machines=args.machines,
         pus_per_machine=args.pus,
@@ -71,6 +91,12 @@ def main():
     ap.add_argument("--cold", action="store_true", help="no warm start between rounds")
     ap.add_argument("--small", action="store_true", help="quick smoke (100 tasks x 10 machines)")
     ap.add_argument("--cpu", action="store_true", help="force JAX cpu backend")
+    ap.add_argument(
+        "--backend",
+        choices=["auto", "jax", "native", "ref"],
+        default="auto",
+        help="MCMF backend: auto picks jax on accelerator, native C++ on cpu",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -101,7 +127,7 @@ def main():
         print(
             f"# fill: placed {len(r.placed_tasks)}/{args.tasks} in {fill_s:.2f}s "
             f"(cold solve, incl. compile), unsched={r.num_unscheduled}, "
-            f"supersteps={backend.last_supersteps}",
+            f"work={_solver_work(backend)}",
             file=sys.stderr,
         )
 
@@ -122,7 +148,7 @@ def main():
                 f"# round {i}: {lat_ms[-1]:.2f}ms placed={len(r.placed_tasks)} "
                 f"(solve={t['solve_s']*1e3:.2f} decode={t['decode_s']*1e3:.2f} "
                 f"stats={t['stats_s']*1e3:.2f} apply={t['apply_s']*1e3:.2f}) "
-                f"supersteps={backend.last_supersteps}",
+                f"work={_solver_work(backend)}",
                 file=sys.stderr,
             )
 
@@ -134,7 +160,7 @@ def main():
                 "metric": (
                     f"p50 scheduling-round latency, {args.tasks} tasks x "
                     f"{args.machines} machines, trivial cost model, "
-                    f"{args.churn:.0%} churn, backend={devices[0].platform}"
+                    f"{args.churn:.0%} churn, backend={args.backend}/{devices[0].platform}"
                 ),
                 "value": round(p50, 3),
                 "unit": "ms",

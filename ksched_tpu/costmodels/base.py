@@ -122,6 +122,16 @@ class CostModeler(abc.ABC):
     @abc.abstractmethod
     def update_stats(self, accumulator: "Node", other: "Node") -> "Node": ...
 
+    # -- policy feedback (no-op defaults; models override as needed) ------
+
+    def note_round(self, unscheduled_task_ids: Sequence[int]) -> None:
+        """Called by the scheduler after every round with the runnable
+        tasks that stayed unscheduled (e.g. Quincy's wait-cost bound)."""
+
+    def record_task_completion(self, td) -> None:
+        """Called by the scheduler when a task completes; models that
+        learn from observed runtimes (SJF, Whare-Map) override this."""
+
     # -- debug ------------------------------------------------------------
 
     def debug_info(self) -> str:

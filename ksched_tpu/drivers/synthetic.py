@@ -170,19 +170,16 @@ def build_cluster(
         resource_id_from_string(root.resource_desc.uuid),
         ResourceStatus(descriptor=root.resource_desc, topology_node=root),
     )
-    cost_model = None
     scheduler = FlowScheduler(
         resource_map,
         job_map,
         task_map,
         root,
         max_tasks_per_pu=max_tasks_per_pu,
-        cost_model=cost_model,
+        cost_model_factory=cost_model_factory,
         backend=backend,
         preemption=preemption,
     )
-    if cost_model_factory is not None:
-        raise NotImplementedError("custom cost-model wiring lands with the CoCo/Whare models")
     for i in range(num_machines):
         add_machine(
             scheduler, resource_map, root, num_cores, pus_per_core, max_tasks_per_pu, machine_index=i

@@ -59,6 +59,7 @@ class FlowScheduler:
         root: ResourceTopologyNodeDescriptor,
         max_tasks_per_pu: int = 1,
         cost_model: Optional[CostModeler] = None,
+        cost_model_factory=None,
         backend: Optional[FlowSolver] = None,
         preemption: bool = False,
     ) -> None:
@@ -69,6 +70,12 @@ class FlowScheduler:
 
         leaf_resource_ids: Set[int] = set()
         self.dimacs_stats = ChangeStats()
+        if cost_model is None and cost_model_factory is not None:
+            # Every model shares the Trivial constructor signature; the
+            # factory form exists because leaf_resource_ids is owned here.
+            cost_model = cost_model_factory(
+                resource_map, task_map, leaf_resource_ids, max_tasks_per_pu
+            )
         self.cost_model = cost_model or TrivialCostModel(
             resource_map, task_map, leaf_resource_ids, max_tasks_per_pu
         )
@@ -116,6 +123,7 @@ class FlowScheduler:
         if not self._unbind_task_from_resource(td, rid):
             raise RuntimeError(f"could not unbind task {td.uid} from resource {rid}")
         td.state = TaskState.COMPLETED
+        self.cost_model.record_task_completion(td)
         self.gm.task_completed(td.uid)
 
     def register_resource(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
@@ -228,6 +236,15 @@ class FlowScheduler:
             timing.graph_update_s = time.perf_counter() - t0
             num_scheduled, deltas = self._run_scheduling_iteration(timing)
             self.dimacs_stats.reset()
+            # Policy feedback: which runnable tasks stayed unscheduled
+            # (drives e.g. Quincy's wait-cost starvation bound).
+            unscheduled = [
+                t
+                for tasks in self.runnable_tasks.values()
+                for t in tasks
+                if t not in self.task_bindings
+            ]
+            self.cost_model.note_round(unscheduled)
         timing.total_s = time.perf_counter() - t_round
         self.last_timing = timing
         return num_scheduled, deltas

@@ -6,6 +6,7 @@ from .ids import (
     job_id_from_string,
     rand_uint64,
     resource_id_from_string,
+    rng,
     seed_rng,
 )
 from .maps import JobMap, ResourceMap, ResourceStatus, TaskMap
@@ -19,6 +20,7 @@ __all__ = [
     "job_id_from_string",
     "rand_uint64",
     "resource_id_from_string",
+    "rng",
     "seed_rng",
     "JobMap",
     "ResourceMap",

@@ -39,6 +39,12 @@ def seed_rng(seed: int) -> None:
     _rng.seed(seed)
 
 
+def rng() -> random.Random:
+    """The framework's global seedable RNG (reference: pkg/util/util.go:
+    52-58 SeedRNGWithInt — the determinism hook tests rely on)."""
+    return _rng
+
+
 def rand_uint64() -> int:
     """Uniform uint64 (the reference's RandUint64 at pkg/util/util.go:68-71
     sums two uint32s and is biased; we fix that here)."""

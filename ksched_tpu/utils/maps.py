@@ -57,6 +57,11 @@ class _TypedMap(Generic[V]):
         with self._lock:
             return key in self._m
 
+    def items(self):
+        """Snapshot of (key, value) pairs under the lock."""
+        with self._lock:
+            return list(self._m.items())
+
     def unsafe_get(self) -> Dict[int, V]:
         """Direct access to the backing dict; caller is responsible for
         not mutating concurrently (reference: types.go UnsafeGet)."""
