@@ -56,18 +56,9 @@ def build(args):
         # an accelerator; the native C++ library when running host-only
         # (the same pairing the reference has with Flowlessly on CPU).
         args.backend = "jax" if not args.cpu else "native"
-    if args.backend == "jax":
-        from ksched_tpu.solver.jax_solver import JaxSolver
+    from ksched_tpu.solver.select import make_backend
 
-        backend = JaxSolver(warm_start=not args.cold)
-    elif args.backend == "native":
-        from ksched_tpu.solver.native import NativeSolver
-
-        backend = NativeSolver(algorithm="cost_scaling", warm_start=not args.cold)
-    else:
-        from ksched_tpu.solver.cpu_ref import ReferenceSolver
-
-        backend = ReferenceSolver()
+    backend = make_backend(args.backend, warm_start=not args.cold)
     cluster = BulkCluster(
         num_machines=args.machines,
         pus_per_machine=args.pus,
@@ -90,7 +81,7 @@ def main():
     ap.add_argument("--churn", type=float, default=0.01)
     ap.add_argument("--cold", action="store_true", help="no warm start between rounds")
     ap.add_argument("--small", action="store_true", help="quick smoke (100 tasks x 10 machines)")
-    ap.add_argument("--cpu", action="store_true", help="force JAX cpu backend")
+    ap.add_argument("--cpu", action="store_true", help="run host-only (skip the accelerator; auto backend then picks the native C++ solver)")
     ap.add_argument(
         "--backend",
         choices=["auto", "jax", "native", "ref"],

@@ -1,0 +1,22 @@
+"""L7 cluster integration: the API-server adapter layer.
+
+Reference: k8s/ — a thin anti-corruption layer between the scheduler
+core and the cluster control plane (k8s/k8sclient/client.go:32-147,
+k8s/k8stype/types.go). The rebuild keeps the same boundary: the
+scheduler consumes pod/node events and emits bindings through the
+ClusterAPI protocol; backends are the in-process SyntheticClusterAPI
+(for benchmarks/tests — the role fakeMachines plays in the reference)
+and, where a kubernetes client is installed, a real adapter following
+the same informer → channel → debounced-batch shape.
+"""
+
+from .api import Binding, ClusterAPI, NodeEvent, PodEvent
+from .synthetic_api import SyntheticClusterAPI
+
+__all__ = [
+    "Binding",
+    "ClusterAPI",
+    "NodeEvent",
+    "PodEvent",
+    "SyntheticClusterAPI",
+]

@@ -1,0 +1,67 @@
+"""The cluster API protocol: pods/nodes in, bindings out.
+
+Reference shape: k8s/k8sclient/client.go —
+- two informers feed buffered channels (pods :49-78, nodes :82-105);
+- `GetPodBatch` debounce-batches pod arrivals (:153-193);
+- `AssignBinding` posts pod→node bindings back (:128-147);
+- internal types Pod{ID}, Node{ID}, Binding{PodID, NodeID}
+  (k8s/k8stype/types.go:3-13).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class PodEvent:
+    """An unscheduled pod surfaced by the control plane."""
+
+    pod_id: str
+    # Optional scheduling inputs (the reference's Pod carries only the
+    # id; the rebuild forwards resource requests when the source has them)
+    cpu_request: float = 0.0
+    net_bw_request: int = 0
+    task_class: int = 0
+
+
+@dataclass(frozen=True)
+class NodeEvent:
+    """A schedulable node surfaced by the control plane."""
+
+    node_id: str
+    num_cores: int = 1
+    pus_per_core: int = 1
+    net_bw_capacity: int = 0
+
+
+@dataclass(frozen=True)
+class Binding:
+    pod_id: str
+    node_id: str
+
+
+class ClusterAPI(abc.ABC):
+    """What the scheduler main loop needs from a control plane."""
+
+    @abc.abstractmethod
+    def get_pod_batch(self, timeout_s: float) -> List[PodEvent]:
+        """Debounced batch: block until the first pod arrives, then keep
+        draining, restarting the quiet-period timer on every arrival,
+        until ``timeout_s`` elapses with no new pod (reference:
+        client.go:153-193). Returns [] only on close/shutdown."""
+
+    @abc.abstractmethod
+    def get_node_batch(self, timeout_s: float) -> List[NodeEvent]:
+        """Same debounce contract for node arrivals (the reference polls
+        its node channel for a fixed window at startup,
+        cmd/k8sscheduler/scheduler.go:206-238)."""
+
+    @abc.abstractmethod
+    def assign_bindings(self, bindings: List[Binding]) -> None:
+        """Push pod→node placements to the control plane."""
+
+    def close(self) -> None:
+        """Stop delivering events; get_*_batch return [] afterwards."""

@@ -14,6 +14,15 @@ Reserved bandwidth is tracked per machine from the tasks bound below it
 (ResourceDescriptor.reserved_resources, resource_desc.proto:54) during
 the stats traversal, keeping the one-pass-per-round contract of
 gather_stats (costmodel/interface.go:120-127).
+
+Known quantization limit (inherent to flow-based scheduling, the issue
+the CoCo line of work exists to solve): the gate prices each task
+against ROUND-START reservations, so several tasks placed in one round
+can collectively overcommit a machine each would individually fit.
+Reservations refresh between rounds, so steady-state incremental
+scheduling (small per-round batches, the reference's operating regime)
+converges; large cold batches of bandwidth-heavy tasks can transiently
+overcommit.
 """
 
 from __future__ import annotations
@@ -28,7 +37,10 @@ from .trivial import TrivialCostModel
 
 CONGESTION_SCALE = 100  # cost at 100% bandwidth reservation
 GATE_COST = 10 * CONGESTION_SCALE  # machine cannot fit the request
-UNSCHEDULED_COST = GATE_COST + 100
+# Above every feasible congestion price but BELOW the gate: a task whose
+# request fits nowhere stays unscheduled rather than overcommitting a
+# gated machine.
+UNSCHEDULED_COST = 2 * CONGESTION_SCALE
 
 
 class NetCostModel(TrivialCostModel):
@@ -79,7 +91,11 @@ class NetCostModel(TrivialCostModel):
 
     def get_task_preference_arcs(self, task_id: int) -> List[int]:
         """Direct arcs to every machine, priced by congestion — the EC
-        wildcard cannot carry per-(task, machine) bandwidth prices."""
+        wildcard cannot carry per-(task, machine) bandwidth prices.
+        Zero-request tasks route via the aggregator alone (identical
+        pricing at a fraction of the arc count)."""
+        if self._task_request(task_id) == 0:
+            return []
         return list(self._machines.keys())
 
     def get_task_equiv_classes(self, task_id: int) -> List[int]:

@@ -66,18 +66,24 @@ class OctopusCostModel(TrivialCostModel):
 
     LOAD_COST_SCALE = 10
 
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._max_slots_seen = 1
+
     def equiv_class_to_resource_node(self, ec: int, resource_id: int) -> Tuple[Cost, int]:
         rs = self.resource_map.find(resource_id)
         if rs is None:
             raise KeyError(f"no resource status for {resource_id}")
         rd = rs.descriptor
+        self._max_slots_seen = max(self._max_slots_seen, rd.num_slots_below)
         free = rd.num_slots_below - rd.num_running_tasks_below
         return self.LOAD_COST_SCALE * rd.num_running_tasks_below, free
 
     def task_to_unscheduled_agg_cost(self, task_id: int) -> Cost:
-        # Must dominate the worst loaded-machine price or full machines
-        # would beat the escape arc and mask infeasibility.
-        return self.LOAD_COST_SCALE * 1000
+        # Must dominate any partially-free machine's price: a full machine
+        # of S slots prices S*scale, so anything above (S+1)*scale keeps
+        # the escape arc dearer than every machine with a free slot.
+        return self.LOAD_COST_SCALE * (self._max_slots_seen + 2)
 
 
 class SjfCostModel(TrivialCostModel):

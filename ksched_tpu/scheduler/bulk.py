@@ -125,6 +125,7 @@ class BulkCluster:
         # endpoints NEVER change: the solver's CSR plan is built once and
         # reused for the lifetime of the cluster (the structure-churn
         # killer for per-round host work).
+        self.machine_enabled = np.ones(num_machines, bool)
         self.task_live = np.zeros(self.task_cap, bool)
         self.task_job = np.zeros(self.task_cap, np.int32)
         self.task_class = np.zeros(self.task_cap, np.int32)
@@ -272,6 +273,40 @@ class BulkCluster:
         for r in rows:
             self._job_free[int(r) % self.J].append(int(r))
 
+    def set_machine_enabled(self, machine_index: int, enabled: bool) -> np.ndarray:
+        """Elastic membership: bring a machine in/out of service
+        (vectorized RegisterResource / DeregisterResource — reference:
+        flowscheduler/scheduler.go:134-210). Disabling evicts every task
+        placed on the machine back to the unscheduled pool; the next
+        round reschedules them elsewhere. Returns the evicted task rows
+        (absolute ids; empty on enable)."""
+        self.machine_enabled[machine_index] = enabled
+        if enabled:
+            return np.empty(0, np.int32)
+        pu_lo = machine_index * self.P
+        pu_hi = pu_lo + self.P
+        rows = np.nonzero(
+            self.task_live & (self.task_pu >= pu_lo) & (self.task_pu < pu_hi)
+        )[0]
+        if not len(rows):
+            return np.empty(0, np.int32)
+        abs_rows = (self.task0 + rows).astype(np.int32)
+        np.add.at(self.pu_running, self.task_pu[rows], -1)
+        np.add.at(self.machine_census, (machine_index, self.task_class[rows]), -1)
+        self.task_pu[rows] = -1
+        # Un-pin: restore supply, re-open the task's arcs, and regrow the
+        # unsched-agg escape capacity the pin consumed (inverse of the
+        # pin step in round()).
+        self.excess[abs_rows] = 1
+        a0 = self.a_task0 + self.arcs_per_task * rows
+        self.cap[a0] = 1
+        self.cap[a0 + 1 + self.task_class[rows]] = 1
+        np.add.at(self.cap, self.a_unsink0 + self.task_job[rows], 1)
+        from ..graph.flowgraph import NodeType
+
+        self.node_type[abs_rows] = int(NodeType.UNSCHEDULED_TASK)
+        return abs_rows
+
     # ------------------------------------------------------------------
     # The scheduling round
     # ------------------------------------------------------------------
@@ -281,6 +316,9 @@ class BulkCluster:
         of ComputeTopologyStatistics + updateEquivToResArcs)."""
         M, C = self.M, self.C
         pu_free = self.S - self.pu_running
+        # Disabled machines (elastic membership / machine loss) offer no
+        # capacity; their PUs are fenced at every layer of the topology.
+        pu_free[~np.repeat(self.machine_enabled, self.P)] = 0
         machine_free = pu_free.reshape(M, self.P).sum(axis=1)
         # Every class EC offers each machine its full free capacity; the
         # machine node's outgoing arcs bottleneck the aggregate.

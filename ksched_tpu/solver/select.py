@@ -1,0 +1,37 @@
+"""One place to pick an MCMF backend by name.
+
+Mirrors the reference's solver selection flags (placement/solver.go:
+30-34) with graceful degradation: "native" needs a C++ toolchain at
+first use (compile-on-demand), so callers that cannot guarantee one get
+the JAX backend instead of a traceback.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .base import FlowSolver
+
+
+def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> FlowSolver:
+    """name: "native" | "jax" | "ref". With fallback=True a failed
+    native build degrades to the JAX solver with a stderr note."""
+    if name == "native":
+        try:
+            from .native import NativeSolver
+
+            return NativeSolver(algorithm="cost_scaling", warm_start=warm_start)
+        except (RuntimeError, OSError, FileNotFoundError) as e:
+            if not fallback:
+                raise
+            print(f"# native backend unavailable ({e}); using jax", file=sys.stderr)
+            name = "jax"
+    if name == "jax":
+        from .jax_solver import JaxSolver
+
+        return JaxSolver(warm_start=warm_start)
+    if name == "ref":
+        from .cpu_ref import ReferenceSolver
+
+        return ReferenceSolver()
+    raise ValueError(f"unknown backend {name!r}; want native | jax | ref")

@@ -1,0 +1,193 @@
+"""L7 integration tests: cluster API debouncing, the scheduler service
+main loop (CLI), podgen, and Google-trace replay."""
+
+import threading
+import time
+
+import numpy as np
+
+from ksched_tpu.cli import SchedulerService, podgen
+from ksched_tpu.cluster import Binding, NodeEvent, PodEvent, SyntheticClusterAPI
+from ksched_tpu.costmodels import CostModelType
+from ksched_tpu.drivers.trace_replay import (
+    FINISH,
+    SUBMIT,
+    TraceReplayDriver,
+    TraceTaskEvent,
+    parse_task_events,
+    synthesize_trace,
+)
+
+
+# -- cluster API ----------------------------------------------------------
+
+
+def test_pod_batch_debounce_drains_queue():
+    api = SyntheticClusterAPI()
+    for i in range(7):
+        api.submit_pod(PodEvent(pod_id=f"p{i}"))
+    batch = api.get_pod_batch(timeout_s=0.05)
+    assert len(batch) == 7
+
+
+def test_pod_batch_timer_resets_on_arrival():
+    """A trickle of pods slower than the quiet period still lands in ONE
+    batch because each arrival resets the timer (client.go:153-193)."""
+    api = SyntheticClusterAPI()
+
+    def trickle():
+        for i in range(4):
+            api.submit_pod(PodEvent(pod_id=f"p{i}"))
+            time.sleep(0.03)
+
+    t = threading.Thread(target=trickle)
+    t.start()
+    batch = api.get_pod_batch(timeout_s=0.15)
+    t.join()
+    assert len(batch) == 4
+
+
+def test_node_batch_startup_window_expires():
+    api = SyntheticClusterAPI()
+    t0 = time.monotonic()
+    assert api.get_node_batch(timeout_s=0.1) == []
+    assert time.monotonic() - t0 < 1.0  # bounded, no hang
+
+
+def test_closed_api_returns_empty():
+    api = SyntheticClusterAPI()
+    api.close()
+    assert api.get_pod_batch(timeout_s=0.05) == []
+
+
+# -- scheduler service (CLI main loop) ------------------------------------
+
+
+def _service(machines=4, pus=2, cost_model=CostModelType.TRIVIAL, max_tasks_per_pu=1):
+    api = SyntheticClusterAPI()
+    svc = SchedulerService(api, max_tasks_per_pu=max_tasks_per_pu, cost_model=cost_model)
+    svc.init_topology(fake_machines=machines, pus_per_core=pus)
+    return api, svc
+
+
+def test_service_schedules_podgen_load():
+    api, svc = _service(machines=4, pus=2)
+    podgen(api, 6)
+    pods = api.get_pod_batch(0.05)
+    bound = svc.run_once(pods)
+    assert bound == 6
+    bindings = api.bindings()
+    assert len(bindings) == 6
+    assert all(n.startswith("fake_node_") for n in bindings.values())
+
+
+def test_service_binds_only_deltas_across_rounds():
+    api, svc = _service(machines=2, pus=2)
+    podgen(api, 2)
+    svc.run_once(api.get_pod_batch(0.05))
+    first = dict(api.bindings())
+    # second round: two more pods; existing bindings must not be re-posted
+    for i in range(2):
+        api.submit_pod(PodEvent(pod_id=f"late_{i}"))
+    bound = svc.run_once(api.get_pod_batch(0.05))
+    assert bound == 2
+    assert dict(list(api.bindings().items())[: len(first)]) == first
+
+
+def test_service_overload_leaves_surplus_unscheduled():
+    api, svc = _service(machines=2, pus=1)  # 2 slots total
+    podgen(api, 5)
+    bound = svc.run_once(api.get_pod_batch(0.05))
+    assert bound == 2
+    assert len(api.bindings()) == 2
+
+
+# -- trace replay ---------------------------------------------------------
+
+
+def test_synthesize_trace_schema():
+    machines, events = synthesize_trace(num_machines=10, num_tasks=50, seed=1)
+    assert len(machines) == 10
+    kinds = {e.event_type for e in events}
+    assert kinds == {SUBMIT, FINISH}
+    times = [e.time_us for e in events]
+    assert times == sorted(times)
+
+
+def test_trace_replay_places_and_retires():
+    machines, events = synthesize_trace(
+        num_machines=20, num_tasks=200, duration_s=300.0, mean_runtime_s=60.0, seed=2
+    )
+    driver = TraceReplayDriver(machines, slots_per_machine=16, num_jobs_hint=8)
+    stats = driver.replay(events, window_s=10.0)
+    assert stats.submitted == 200
+    assert stats.finished == 200
+    assert stats.placed >= 180  # nearly everything should find a slot
+    assert stats.rounds > 5
+    assert stats.p50_ms > 0
+    # all tasks retired: cluster is empty again
+    assert driver.cluster.num_live_tasks == 0
+
+
+def test_trace_replay_machine_churn_evicts_and_reschedules():
+    """A mid-trace machine REMOVE must evict its tasks; later rounds
+    reschedule them onto surviving machines."""
+    from ksched_tpu.drivers.trace_replay import MACHINE_ADD, MACHINE_REMOVE, TraceMachineEvent
+
+    machines = [
+        TraceMachineEvent(time_us=0, machine_id=1, event_type=MACHINE_ADD),
+        TraceMachineEvent(time_us=0, machine_id=2, event_type=MACHINE_ADD),
+        # machine 1 dies at t=30s
+        TraceMachineEvent(time_us=30_000_000, machine_id=1, event_type=MACHINE_REMOVE),
+    ]
+    events = [
+        TraceTaskEvent(time_us=1_000_000 * i, job_id=1, task_index=i, event_type=SUBMIT)
+        for i in range(8)
+    ] + [
+        TraceTaskEvent(time_us=60_000_000 + 1_000_000 * i, job_id=1, task_index=i,
+                       event_type=FINISH)
+        for i in range(8)
+    ]
+    events.sort(key=lambda e: e.time_us)
+    driver = TraceReplayDriver(machines, slots_per_machine=8, num_jobs_hint=2)
+    stats = driver.replay(events, window_s=5.0)
+    assert stats.submitted == 8 and stats.finished == 8
+    assert not driver.cluster.machine_enabled[driver._machine_index[1]]
+    # anything evicted from machine 1 was re-placed (placed >= submitted)
+    assert stats.placed >= stats.submitted
+    assert driver.cluster.num_live_tasks == 0
+
+
+def test_bulk_set_machine_enabled_invariants():
+    from ksched_tpu.scheduler.bulk import BulkCluster
+    from ksched_tpu.solver.native import NativeSolver
+
+    c = BulkCluster(num_machines=2, pus_per_machine=2, slots_per_pu=2,
+                    num_jobs=1, backend=NativeSolver(), task_capacity=16)
+    c.add_tasks(8, np.zeros(8, np.int32))
+    r = c.round()
+    assert len(r.placed_tasks) == 8
+    evicted = c.set_machine_enabled(0, False)
+    assert len(evicted) == 4  # half the slots lived on machine 0
+    assert (c.excess[evicted] == 1).all()
+    r2 = c.round()
+    # machine 1 is full (4 tasks): evictees stay unscheduled
+    assert len(r2.placed_tasks) == 0 and r2.num_unscheduled == 4
+    c.set_machine_enabled(0, True)
+    r3 = c.round()
+    assert len(r3.placed_tasks) == 4  # rescheduled after recovery
+    assert c.num_placed_tasks == 8
+
+
+def test_parse_task_events_csv(tmp_path):
+    p = tmp_path / "task_events.csv"
+    p.write_text(
+        "0,,3,0,,0,u,2,1,0.5,0.1,0.0,0\n"
+        "1000000,,3,0,,4,u,2,1,,,,\n"
+    )
+    evs = list(parse_task_events(str(p)))
+    assert evs[0] == TraceTaskEvent(
+        time_us=0, job_id=3, task_index=0, event_type=SUBMIT,
+        scheduling_class=2, priority=1, cpu_req=0.5,
+    )
+    assert evs[1].event_type == FINISH

@@ -156,6 +156,22 @@ def test_net_gates_machines_without_bandwidth():
         assert resource_id_from_string(node.resource_desc.uuid) == machines[0]
 
 
+def test_net_leaves_unfittable_task_unscheduled():
+    # Request 50 exceeds every machine's bandwidth: the unsched escape
+    # (cheaper than the gate) must win — no overcommitted placement.
+    sched, rmap, jmap, tmap, root = _cluster(NetCostModel, machines=2, pus=2)
+    model: NetCostModel = sched.cost_model
+    for m in model._machines:
+        rmap.find(m).descriptor.capacity.net_bw = 10
+    job = add_job(sched, jmap, tmap, num_tasks=1)
+    for t, td in tmap.items():
+        if td.job_id == str(job):
+            td.resource_request.net_bw = 50
+    n, _ = sched.schedule_all_jobs()
+    assert n == 0
+    assert sched.get_task_bindings() == {}
+
+
 def test_void_keeps_supply_conserved():
     sched, rmap, jmap, tmap, root = _cluster(VoidCostModel)
     add_job(sched, jmap, tmap, num_tasks=3)
