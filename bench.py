@@ -58,7 +58,7 @@ def build(args):
         args.backend = "jax" if not args.cpu else "native"
     from ksched_tpu.solver.select import make_backend
 
-    backend = make_backend(args.backend, warm_start=not args.cold)
+    backend = make_backend(args.backend, warm_start=not args.cold, fallback=False)
     cluster = BulkCluster(
         num_machines=args.machines,
         pus_per_machine=args.pus,

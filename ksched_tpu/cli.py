@@ -216,6 +216,8 @@ def main(argv=None) -> int:
     ap.add_argument("--one-shot", action="store_true",
                     help="exit once the pod queue is drained")
     args = ap.parse_args(argv)
+    if args.one_shot and args.podgen <= 0:
+        ap.error("--one-shot needs --podgen N: the pod wait blocks until a first pod arrives")
 
     from .solver.select import make_backend
 

@@ -1,0 +1,20 @@
+"""Runtime auxiliary subsystems: failure detection, checkpoint/resume,
+round tracing.
+
+The reference carries the *fields* for all three (heartbeats on
+ResourceStatus/TaskDescriptor, ResourceState LOST, ad hoc round timing)
+but implements none of them (SURVEY §5). Here they are first-class.
+"""
+
+from .checkpoint import load_bulk_checkpoint, restore_scheduler, save_bulk_checkpoint, save_scheduler
+from .failure import HeartbeatMonitor
+from .trace import RoundTracer
+
+__all__ = [
+    "HeartbeatMonitor",
+    "RoundTracer",
+    "load_bulk_checkpoint",
+    "restore_scheduler",
+    "save_bulk_checkpoint",
+    "save_scheduler",
+]

@@ -1,0 +1,192 @@
+"""Checkpoint / resume.
+
+The reference has none: all state is in-memory and restart means a cold
+rebuild from the API server's world view (SURVEY §5). The rebuild keeps
+that reconstructibility property AND makes it a feature:
+
+- FlowScheduler checkpoints are the *host descriptors only* (topology
+  roots, jobs/tasks, bindings) — exactly the world state an API server
+  would hold. Restore replays them through the normal event API
+  (register_resource / add_job / placement pinning), so the restored
+  graph is rebuilt by the same code paths production uses, never by
+  poking internals.
+- BulkCluster checkpoints are the flat device-shaped arrays themselves,
+  written as npz: restore is a buffer upload, the natural device-state
+  checkpoint for the array path.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..data import JobState, TaskState
+from ..scheduler import FlowScheduler
+from ..utils import JobMap, ResourceMap, ResourceStatus, TaskMap, resource_id_from_string
+
+CHECKPOINT_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# FlowScheduler (event-path) checkpoints
+# ---------------------------------------------------------------------------
+
+
+def save_scheduler(scheduler: FlowScheduler, path: str) -> None:
+    """Snapshot the world state: topology roots, jobs (task trees ride
+    along via root_task.spawned), and task→PU bindings."""
+    jobs = {jid: jd for jid, jd in scheduler.job_map.items()}
+    state = {
+        "version": CHECKPOINT_VERSION,
+        "coordinator": scheduler.resource_topology,
+        "jobs": jobs,
+        "bindings": dict(scheduler.task_bindings),
+        "max_tasks_per_pu": scheduler.gm.max_tasks_per_pu,
+    }
+    with open(path, "wb") as f:
+        pickle.dump(state, f)
+
+
+def restore_scheduler(
+    path: str,
+    cost_model_factory=None,
+    backend=None,
+) -> Tuple[FlowScheduler, ResourceMap, JobMap, TaskMap]:
+    """Rebuild a scheduler from a checkpoint by replaying the event API.
+
+    Placements are restored by pinning each bound task through the
+    normal placement path, so bindings, resource stats, and graph state
+    all agree — the same invariant a live scheduler maintains.
+    """
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if state["version"] != CHECKPOINT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {state['version']}")
+
+    resource_map = ResourceMap()
+    job_map = JobMap()
+    task_map = TaskMap()
+    coordinator = state["coordinator"]
+
+    def register_subtree(rtnd):
+        rid = resource_id_from_string(rtnd.resource_desc.uuid)
+        resource_map.insert(
+            rid, ResourceStatus(descriptor=rtnd.resource_desc, topology_node=rtnd)
+        )
+        for ch in rtnd.children:
+            register_subtree(ch)
+
+    register_subtree(coordinator)
+    # Clear runtime aggregates the replay will rebuild.
+    for _, rs in resource_map.items():
+        rs.descriptor.current_running_tasks = []
+        rs.descriptor.num_running_tasks_below = 0
+        rs.descriptor.num_slots_below = 0
+
+    scheduler = FlowScheduler(
+        resource_map,
+        job_map,
+        task_map,
+        coordinator,
+        max_tasks_per_pu=state["max_tasks_per_pu"],
+        cost_model_factory=cost_model_factory,
+        backend=backend,
+    )
+    # Each machine subtree under the coordinator goes through the normal
+    # registration path (the constructor already registered the root).
+    for machine in coordinator.children:
+        scheduler.register_resource(machine)
+
+    # Jobs + tasks. Previously-running tasks are reset to RUNNABLE so the
+    # graph build creates their nodes; their recorded placements are then
+    # re-pinned below (flipping them back to RUNNING).
+    for jid, jd in state["jobs"].items():
+        job_map.insert(jid, jd)
+        stack = [jd.root_task] if jd.root_task else []
+        while stack:
+            td = stack.pop()
+            task_map.insert(td.uid, td)
+            stack.extend(td.spawned)
+            if td.uid in state["bindings"] and td.state == TaskState.RUNNING:
+                # CREATED (not RUNNABLE) so _compute_runnable_tasks_for_job
+                # promotes it and registers it in the runnable set.
+                td.state = TaskState.CREATED
+                td.scheduled_to_resource = ""
+        if jd.state not in (JobState.COMPLETED, JobState.FAILED, JobState.ABORTED):
+            scheduler.add_job(jd)
+
+    # Build task nodes WITHOUT a solve (no phantom placements), then
+    # re-pin the recorded bindings through the normal placement path.
+    jds = [
+        jd
+        for jd in scheduler.jobs_to_schedule.values()
+        if scheduler._compute_runnable_tasks_for_job(jd)
+    ]
+    if jds:
+        scheduler.gm.compute_topology_statistics(scheduler.gm.sink_node)
+        scheduler.gm.add_or_update_job_nodes(jds)
+    for task_id, pu_rid in state["bindings"].items():
+        td = task_map.find(task_id)
+        rs = resource_map.find(pu_rid)
+        if td is None or rs is None:
+            continue
+        scheduler.handle_task_placement(td, rs.descriptor)
+    return scheduler, resource_map, job_map, task_map
+
+
+# ---------------------------------------------------------------------------
+# BulkCluster (array-path) checkpoints
+# ---------------------------------------------------------------------------
+
+_BULK_ARRAYS = (
+    "src", "dst", "cap", "cost", "excess", "node_type",
+    "task_live", "task_job", "task_class", "task_pu",
+    "pu_running", "machine_census", "machine_enabled",
+)
+
+
+def save_bulk_checkpoint(cluster, path: str) -> None:
+    """Write the flat arrays + geometry to npz (device-state snapshot)."""
+    meta = np.array(
+        [cluster.M, cluster.P, cluster.S, cluster.J, cluster.C,
+         cluster.unsched_cost, cluster.ec_cost, cluster.task_cap],
+        dtype=np.int64,
+    )
+    arrays = {name: getattr(cluster, name) for name in _BULK_ARRAYS}
+    np.savez_compressed(path, __meta__=meta, **arrays)
+
+
+def load_bulk_checkpoint(
+    path: str, backend, machine_cost_fn=None, class_cost_fn=None
+) -> "BulkCluster":
+    """Rebuild a BulkCluster around checkpointed arrays. Cost callbacks
+    are code, not data — pass the same machine_cost_fn/class_cost_fn the
+    saved cluster used or its per-round cost refresh stays frozen."""
+    from ..scheduler.bulk import BulkCluster
+
+    data = np.load(path)
+    M, P, S, J, C, unsched_cost, ec_cost, task_cap = data["__meta__"]
+    cluster = BulkCluster(
+        num_machines=int(M),
+        pus_per_machine=int(P),
+        slots_per_pu=int(S),
+        num_jobs=int(J),
+        backend=backend,
+        unsched_cost=int(unsched_cost),
+        ec_cost=int(ec_cost),
+        machine_cost_fn=machine_cost_fn,
+        class_cost_fn=class_cost_fn,
+        num_task_classes=int(C),
+        task_capacity=int(task_cap),
+    )
+    for name in _BULK_ARRAYS:
+        getattr(cluster, name)[...] = data[name]
+    # Rebuild the per-job free-row pools from task_live (single pass,
+    # descending rows to match the constructor's pop order).
+    cluster._job_free = [[] for _ in range(cluster.J)]
+    for r in range(cluster.task_cap - 1, -1, -1):
+        if not cluster.task_live[r]:
+            cluster._job_free[r % cluster.J].append(r)
+    return cluster

@@ -1,0 +1,90 @@
+"""Heartbeat-driven failure detection.
+
+The reference stores heartbeats (ResourceStatus.LastHeartbeat,
+resourcestatus.go:26; TaskDescriptor.last_heartbeat_*, task_desc.proto:
+46-47) and defines ResourceState LOST (resource_desc.proto:22) but
+ships no checker — machine loss must be driven externally through
+DeregisterResource (flowscheduler/scheduler.go:162-210). This monitor
+closes the loop: heartbeats in, expiry sweep, and the reference's own
+reaction machinery out (deregister for lost machines, HandleTaskFailure
+for silent tasks).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..data import ResourceState, TaskState
+from ..scheduler import FlowScheduler
+from ..utils import resource_id_from_string
+
+
+class HeartbeatMonitor:
+    def __init__(
+        self,
+        scheduler: FlowScheduler,
+        machine_timeout_s: float = 30.0,
+        task_timeout_s: float = 60.0,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
+        self.scheduler = scheduler
+        self.machine_timeout_s = machine_timeout_s
+        self.task_timeout_s = task_timeout_s
+        self.clock = clock or time.monotonic
+
+    # -- heartbeat ingestion ----------------------------------------------
+
+    def record_machine_heartbeat(self, resource_id: int, now: Optional[float] = None) -> None:
+        rs = self.scheduler.resource_map.find(resource_id)
+        if rs is None:
+            raise KeyError(f"heartbeat for unknown resource {resource_id}")
+        rs.last_heartbeat = now if now is not None else self.clock()
+
+    def record_task_heartbeat(self, task_id: int, now: Optional[float] = None) -> None:
+        td = self.scheduler.task_map.find(task_id)
+        if td is None:
+            raise KeyError(f"heartbeat for unknown task {task_id}")
+        td.last_heartbeat_time = int((now if now is not None else self.clock()) * 1e9)
+
+    # -- expiry sweep ------------------------------------------------------
+
+    def check(self, now: Optional[float] = None) -> Tuple[List[int], List[int]]:
+        """One failure-detection sweep. Returns (lost machine resource
+        ids, failed task ids). Lost machines are marked LOST and
+        deregistered (evicting their tasks back to runnable); silent
+        RUNNING tasks are failed via HandleTaskFailure."""
+        now = now if now is not None else self.clock()
+        lost_machines: List[int] = []
+        failed_tasks: List[int] = []
+
+        # Machines: registered roots' machine children with stale beats.
+        for rid, rs in self.scheduler.resource_map.items():
+            rd = rs.descriptor
+            if rd.type.name != "MACHINE":
+                continue
+            hb = rs.last_heartbeat
+            if not hb:
+                continue  # never heartbeated: not monitored
+            if now - hb > self.machine_timeout_s and rd.state != ResourceState.LOST:
+                rd.state = ResourceState.LOST
+                lost_machines.append(rid)
+
+        for rid in lost_machines:
+            rs = self.scheduler.resource_map.find(rid)
+            if rs is not None and rs.topology_node is not None:
+                self.scheduler.deregister_resource(rs.topology_node)
+
+        # Tasks: RUNNING with stale beats (only tasks that ever beat).
+        for tid, td in self.scheduler.task_map.items():
+            if td.state != TaskState.RUNNING or td.last_heartbeat_time == 0:
+                continue
+            if td.uid not in self.scheduler.task_bindings:
+                continue  # already unbound by a machine loss above
+            if now - td.last_heartbeat_time / 1e9 > self.task_timeout_s:
+                failed_tasks.append(tid)
+
+        for tid in failed_tasks:
+            td = self.scheduler.task_map.find(tid)
+            self.scheduler.handle_task_failure(td)
+        return lost_machines, failed_tasks

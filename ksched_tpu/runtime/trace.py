@@ -1,0 +1,111 @@
+"""First-class round tracing.
+
+The reference times rounds ad hoc in its CLI (`time.Since` around
+ScheduleAllJobs, cmd/k8sscheduler/scheduler.go:146-150) and discards the
+solver's own timing lines (placement/solver.go:169-170). Here every
+round yields a structured record — per-phase wall clock (the RoundTiming
+breakdown), mutation counts (ChangeStats), solver effort — exportable as
+JSON lines and summarizable as percentiles.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class RoundRecord:
+    round_index: int
+    wall_time: float  # epoch seconds at record time
+    phases_ms: Dict[str, float]
+    num_scheduled: int = 0
+    solver_work: int = 0  # supersteps / iterations / augmentations
+    nodes_added: int = 0
+    arcs_added: int = 0
+    arcs_changed: int = 0
+    arcs_removed: int = 0
+
+
+class RoundTracer:
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self.records: List[RoundRecord] = []
+        self.capacity = capacity
+
+    # -- recording --------------------------------------------------------
+
+    def record_flow_round(self, scheduler, num_scheduled: int) -> RoundRecord:
+        """Capture a FlowScheduler round from its last_timing + stats."""
+        t = scheduler.last_timing
+        stats = scheduler.dimacs_stats
+        backend = getattr(scheduler.solver, "backend", None)
+        rec = RoundRecord(
+            round_index=len(self.records),
+            wall_time=time.time(),
+            phases_ms={
+                "stats": t.stats_s * 1e3,
+                "graph_update": t.graph_update_s * 1e3,
+                "solve": t.solve_s * 1e3,
+                "deltas": t.deltas_s * 1e3,
+                "apply": t.apply_s * 1e3,
+                "total": t.total_s * 1e3,
+            },
+            num_scheduled=num_scheduled,
+            solver_work=getattr(backend, "last_iterations", 0)
+            or getattr(backend, "last_supersteps", 0),
+            nodes_added=stats.nodes_added,
+            arcs_added=stats.arcs_added,
+            arcs_changed=stats.arcs_changed,
+            arcs_removed=stats.arcs_removed,
+        )
+        self._append(rec)
+        return rec
+
+    def record_bulk_round(self, cluster, result) -> RoundRecord:
+        """Capture a BulkCluster round from its BulkRoundResult."""
+        backend = cluster.backend
+        phases_ms = {k[:-2]: v * 1e3 for k, v in result.timing.items()}
+        phases_ms.setdefault("total", sum(phases_ms.values()))
+        rec = RoundRecord(
+            round_index=len(self.records),
+            wall_time=time.time(),
+            phases_ms=phases_ms,
+            num_scheduled=len(result.placed_tasks),
+            solver_work=getattr(backend, "last_supersteps", 0)
+            or getattr(backend, "last_iterations", 0),
+        )
+        self._append(rec)
+        return rec
+
+    def _append(self, rec: RoundRecord) -> None:
+        self.records.append(rec)
+        if self.capacity is not None and len(self.records) > self.capacity:
+            del self.records[0]
+
+    # -- export -----------------------------------------------------------
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(asdict(r)) for r in self.records)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_jsonl() + ("\n" if self.records else ""))
+
+    def summary(self, phase: str = "total") -> Dict[str, float]:
+        vals = np.array(
+            [r.phases_ms.get(phase, 0.0) for r in self.records], dtype=np.float64
+        )
+        if not len(vals):
+            return {"rounds": 0}
+        return {
+            "rounds": len(vals),
+            "p50_ms": float(np.percentile(vals, 50)),
+            "p90_ms": float(np.percentile(vals, 90)),
+            "p99_ms": float(np.percentile(vals, 99)),
+            "mean_ms": float(vals.mean()),
+            "max_ms": float(vals.max()),
+        }

@@ -1,0 +1,174 @@
+"""Aux-subsystem tests: heartbeat failure detection, checkpoint/resume
+(event path and array path), and round tracing."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ksched_tpu.data import ResourceState, TaskState
+from ksched_tpu.drivers import add_job, build_cluster
+from ksched_tpu.runtime import (
+    HeartbeatMonitor,
+    RoundTracer,
+    load_bulk_checkpoint,
+    restore_scheduler,
+    save_bulk_checkpoint,
+    save_scheduler,
+)
+from ksched_tpu.utils import resource_id_from_string
+
+# -- failure detection ----------------------------------------------------
+
+
+def _machine_rids(sched, rmap):
+    return [
+        rid for rid, rs in rmap.items() if rs.descriptor.type.name == "MACHINE"
+    ]
+
+
+def test_machine_loss_detected_and_tasks_requeued():
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=2, pus_per_core=2, max_tasks_per_pu=1
+    )
+    add_job(sched, jmap, tmap, num_tasks=4)
+    n, _ = sched.schedule_all_jobs()
+    assert n == 4
+    mon = HeartbeatMonitor(sched, machine_timeout_s=10.0, clock=lambda: 0.0)
+    machines = _machine_rids(sched, rmap)
+    for m in machines:
+        mon.record_machine_heartbeat(m, now=100.0)
+    # machine 0 goes silent; machine 1 keeps beating
+    mon.record_machine_heartbeat(machines[1], now=130.0)
+    lost, failed = mon.check(now=130.0)
+    assert lost == [machines[0]]
+    assert rmap.find(machines[0]) is None  # pruned from the map
+    # its two tasks are runnable again and the other machine still holds 2
+    assert len(sched.get_task_bindings()) == 2
+    # next round can replace nothing (machine 1 full) but supply conserved
+    assert sched.gm.sink_node.excess == -len(sched.gm.task_to_node)
+
+
+def test_task_silence_fails_task():
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=1, pus_per_core=2)
+    add_job(sched, jmap, tmap, num_tasks=2)
+    sched.schedule_all_jobs()
+    mon = HeartbeatMonitor(sched, task_timeout_s=5.0, clock=lambda: 0.0)
+    bound = list(sched.get_task_bindings().keys())
+    mon.record_task_heartbeat(bound[0], now=100.0)
+    mon.record_task_heartbeat(bound[1], now=109.0)
+    lost, failed = mon.check(now=110.0)
+    assert failed == [bound[0]]
+    assert tmap.find(bound[0]).state == TaskState.FAILED
+    assert bound[0] not in sched.get_task_bindings()
+    assert bound[1] in sched.get_task_bindings()
+
+
+def test_never_heartbeated_entities_not_monitored():
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=1)
+    mon = HeartbeatMonitor(sched, clock=lambda: 1e9)
+    lost, failed = mon.check()
+    assert lost == [] and failed == []
+
+
+# -- checkpoint / resume (event path) -------------------------------------
+
+
+def test_scheduler_checkpoint_roundtrip(tmp_path):
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=3, pus_per_core=2, max_tasks_per_pu=1
+    )
+    add_job(sched, jmap, tmap, num_tasks=4)
+    n, _ = sched.schedule_all_jobs()
+    assert n == 4
+    before = dict(sched.get_task_bindings())
+
+    path = tmp_path / "sched.ckpt"
+    save_scheduler(sched, str(path))
+    sched2, rmap2, jmap2, tmap2 = restore_scheduler(str(path))
+
+    assert dict(sched2.get_task_bindings()) == before
+    # restored tasks are RUNNING and bound resources BUSY
+    for tid, rid in before.items():
+        assert tmap2.find(tid).state == TaskState.RUNNING
+        assert rmap2.find(rid).descriptor.state == ResourceState.BUSY
+    # supply invariant holds in the restored graph
+    assert sched2.gm.sink_node.excess == -len(sched2.gm.task_to_node)
+    # the restored scheduler keeps scheduling: new job lands on free slots
+    add_job(sched2, jmap2, tmap2, num_tasks=2)
+    n2, _ = sched2.schedule_all_jobs()
+    assert n2 == 2
+
+
+def test_scheduler_checkpoint_preserves_unscheduled_backlog(tmp_path):
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=1, max_tasks_per_pu=1)
+    add_job(sched, jmap, tmap, num_tasks=3)  # 1 slot, 3 tasks
+    n, _ = sched.schedule_all_jobs()
+    assert n == 1
+    save_scheduler(sched, str(tmp_path / "s.ckpt"))
+    sched2, rmap2, jmap2, tmap2 = restore_scheduler(str(tmp_path / "s.ckpt"))
+    assert len(sched2.get_task_bindings()) == 1
+    # backlog survives: nothing placed (cluster full), but both runnable
+    assert sched2.gm.sink_node.excess == -len(sched2.gm.task_to_node)
+
+
+# -- checkpoint / resume (array path) -------------------------------------
+
+
+def test_bulk_checkpoint_roundtrip(tmp_path):
+    from ksched_tpu.scheduler.bulk import BulkCluster
+    from ksched_tpu.solver.native import NativeSolver
+
+    c = BulkCluster(num_machines=4, pus_per_machine=2, slots_per_pu=2,
+                    num_jobs=2, backend=NativeSolver(), task_capacity=64)
+    rng = np.random.default_rng(0)
+    c.add_tasks(10, rng.integers(0, 2, 10).astype(np.int32))
+    c.round()
+    placed_before = c.num_placed_tasks
+
+    path = str(tmp_path / "bulk.npz")
+    save_bulk_checkpoint(c, path)
+    c2 = load_bulk_checkpoint(path, backend=NativeSolver())
+    assert c2.num_live_tasks == c.num_live_tasks
+    assert c2.num_placed_tasks == placed_before
+    assert (c2.task_pu == c.task_pu).all()
+    # resumed cluster schedules on: add more tasks and run a round
+    c2.add_tasks(6, rng.integers(0, 2, 6).astype(np.int32))
+    r = c2.round()
+    assert len(r.placed_tasks) == 6
+    assert c2.num_placed_tasks == placed_before + 6
+
+
+# -- tracing ---------------------------------------------------------------
+
+
+def test_tracer_records_flow_rounds(tmp_path):
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=2, pus_per_core=2)
+    tracer = RoundTracer()
+    for k in range(3):
+        add_job(sched, jmap, tmap, num_tasks=1)
+        n, _ = sched.schedule_all_jobs()
+        tracer.record_flow_round(sched, n)
+    assert len(tracer.records) == 3
+    s = tracer.summary("total")
+    assert s["rounds"] == 3 and s["p50_ms"] > 0
+    p = tmp_path / "trace.jsonl"
+    tracer.dump(str(p))
+    lines = [json.loads(line) for line in p.read_text().splitlines()]
+    assert len(lines) == 3
+    assert lines[0]["phases_ms"]["solve"] >= 0
+    assert lines[0]["num_scheduled"] == 1
+
+
+def test_tracer_records_bulk_rounds():
+    from ksched_tpu.scheduler.bulk import BulkCluster
+    from ksched_tpu.solver.native import NativeSolver
+
+    c = BulkCluster(num_machines=2, pus_per_machine=1, slots_per_pu=2,
+                    num_jobs=1, backend=NativeSolver(), task_capacity=16)
+    tracer = RoundTracer(capacity=2)
+    for _ in range(3):
+        c.add_tasks(1, np.zeros(1, np.int32))
+        tracer.record_bulk_round(c, c.round())
+    assert len(tracer.records) == 2  # ring capacity
+    assert tracer.records[-1].phases_ms["solve"] >= 0
