@@ -353,6 +353,11 @@ class BulkCluster:
         )
 
     def round(self) -> BulkRoundResult:
+        # Backends exposing solve_layered get the dense fast path: the
+        # aggregate topology collapses to a [C, M+1] transportation
+        # problem (solver/layered.py) — no CSR, no per-arc work.
+        if hasattr(self.backend, "solve_layered"):
+            return self._round_layered()
         timing: Dict[str, float] = {}
         t0 = time.perf_counter()
         self._refresh_capacities()
@@ -371,31 +376,109 @@ class BulkCluster:
         timing["decode_s"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        if len(placed_tasks):
-            rows = placed_tasks - self.task0
-            self.task_pu[rows] = placed_pus - self.pu0
-            np.add.at(self.pu_running, placed_pus - self.pu0, 1)
-            np.add.at(
-                self.machine_census,
-                ((placed_pus - self.pu0) // self.P, self.task_class[rows]),
-                1,
-            )
-            # pin: remove the placed tasks' supply and arcs from the
-            # flow problem; their slots are excluded via pu_running.
-            self.excess[placed_tasks] = 0
-            a0 = self.a_task0 + self.arcs_per_task * rows
-            self.cap[a0] = 0
-            self.cap[a0 + 1 + self.task_class[rows]] = 0
-            np.add.at(self.cap, self.a_unsink0 + self.task_job[rows], -1)
-            from ..graph.flowgraph import NodeType
-
-            self.node_type[placed_tasks] = int(NodeType.SCHEDULED_TASK)
+        self._apply_placements(placed_tasks, placed_pus)
         timing["apply_s"] = time.perf_counter() - t0
         return BulkRoundResult(
             placed_tasks=placed_tasks,
             placed_pus=placed_pus,
             preempted_tasks=np.empty(0, np.int32),
             num_unscheduled=num_unsched,
+            timing=timing,
+        )
+
+    def _apply_placements(self, placed_tasks: np.ndarray, placed_pus: np.ndarray) -> None:
+        if not len(placed_tasks):
+            return
+        rows = placed_tasks - self.task0
+        self.task_pu[rows] = placed_pus - self.pu0
+        np.add.at(self.pu_running, placed_pus - self.pu0, 1)
+        np.add.at(
+            self.machine_census,
+            ((placed_pus - self.pu0) // self.P, self.task_class[rows]),
+            1,
+        )
+        # pin: remove the placed tasks' supply and arcs from the
+        # flow problem; their slots are excluded via pu_running.
+        self.excess[placed_tasks] = 0
+        a0 = self.a_task0 + self.arcs_per_task * rows
+        self.cap[a0] = 0
+        self.cap[a0 + 1 + self.task_class[rows]] = 0
+        np.add.at(self.cap, self.a_unsink0 + self.task_job[rows], -1)
+        from ..graph.flowgraph import NodeType
+
+        self.node_type[placed_tasks] = int(NodeType.SCHEDULED_TASK)
+
+    def _round_layered(self) -> BulkRoundResult:
+        """The dense fast path: aggregate counts -> [C, M+1] transport
+        solve -> rank-matched decode. Produces the same objective as the
+        generic path (tasks within a class are cost-interchangeable)."""
+        from ..solver.layered import LayeredProblem
+
+        timing: Dict[str, float] = {}
+        M, C = self.M, self.C
+        t0 = time.perf_counter()
+        self._refresh_capacities()  # keeps arrays/costs consistent for
+        # checkpoints and for any later generic-path round
+        pu_free = self.S - self.pu_running
+        pu_free[~np.repeat(self.machine_enabled, self.P)] = 0
+        machine_free = pu_free.reshape(M, self.P).sum(axis=1)
+        unplaced = np.nonzero(self.task_live & (self.task_pu < 0))[0]
+        cls = self.task_class[unplaced]
+        supply = np.bincount(cls, minlength=C).astype(np.int32)
+        cost_cm = self.cost[self.a_ecm0 : self.a_ecm0 + C * M].reshape(C, M)
+        lp = LayeredProblem(
+            supply=supply,
+            col_cap=machine_free.astype(np.int32),
+            cost_cm=cost_cm,
+            unsched_cost=self.unsched_cost,
+            ec_cost=self.ec_cost,
+        )
+        timing["stats_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        res = self.backend.solve_layered(lp)
+        timing["solve_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        y = res.y  # int64[C, M]
+        placed_per_class = y.sum(axis=1)
+        # Stage 1 — pick which tasks place (any within-class choice is
+        # cost-identical) and pair them rank-for-rank with the machine
+        # grants, machine-major per class.
+        placed_rows = np.empty(int(placed_per_class.sum()), dtype=np.int64)
+        machine_of_task = np.empty(len(placed_rows), dtype=np.int64)
+        off = 0
+        for c in range(C):
+            k = int(placed_per_class[c])
+            if not k:
+                continue
+            placed_rows[off : off + k] = unplaced[cls == c][:k]
+            machine_of_task[off : off + k] = np.repeat(
+                np.arange(M, dtype=np.int64), y[c]
+            )
+            off += k
+        # Stage 2 — split each machine's grant across its PUs in slot
+        # order, then pair with tasks sorted (stably) by machine.
+        t_m = y.sum(axis=0)
+        pf2 = pu_free.reshape(M, self.P)
+        excl = np.cumsum(pf2, axis=1) - pf2
+        grants = np.clip(t_m[:, None] - excl, 0, pf2)
+        assert (grants.sum(axis=1) == t_m).all(), "PU split infeasible"
+        pu_grants = np.repeat(np.arange(self.num_pus, dtype=np.int64), grants.reshape(-1))
+        order = np.argsort(machine_of_task, kind="stable")
+        placed_pus = np.empty(len(placed_rows), dtype=np.int32)
+        placed_pus[order] = (self.pu0 + pu_grants).astype(np.int32)
+        placed_tasks = (self.task0 + placed_rows).astype(np.int32)
+        timing["decode_s"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        self._apply_placements(placed_tasks, placed_pus)
+        timing["apply_s"] = time.perf_counter() - t0
+        return BulkRoundResult(
+            placed_tasks=placed_tasks,
+            placed_pus=placed_pus,
+            preempted_tasks=np.empty(0, np.int32),
+            num_unscheduled=res.num_unsched,
             timing=timing,
         )
 

@@ -1,0 +1,424 @@
+"""Dense layered MCMF: the TPU fast path for the aggregate topology.
+
+The quincy-style scheduling graph the bulk scheduler builds
+(scheduler/bulk.py; reference: trivial_cost_modeler.go:101-110 +
+graph_manager.go:931-1010) is layered and aggregate:
+
+    task --(u)--> unsched[job] --> sink
+    task --(e)--> EC[class]
+    EC[c] --(cost[c,m], cap free_m)--> machine_m --> PU --> sink
+
+Tasks of one class are interchangeable (identical arc costs u and e for
+every job — trivial_cost_modeler.go:41-43,69-74), the PU layer never
+binds tighter than its machine (machine free capacity IS the sum of its
+PU free capacities), and the per-job unscheduled aggregators always have
+enough escape capacity. So the min-cost flow collapses EXACTLY to a
+transportation problem over a dense [C, M+1] matrix:
+
+    minimize    sum_{c,m} y[c,m] * w[c,m]
+    subject to  sum_m y[c,m] == supply[c]          (every task routed)
+                sum_c y[c,m] <= col_cap[m]         (machine free slots)
+
+with w[c,m] = cost[c,m] + e - u for real machines and w[c,M] = 0 for the
+"unscheduled" column (cap = total supply, so the problem is always
+feasible — the unscheduled-aggregator invariant, graph_manager.go:
+1270-1305). The full 10k-task solve becomes a ~[4, 1024] dense problem.
+
+Why this is the TPU formulation: the general CSR push-relabel
+(solver/jax_solver.py) is correct for arbitrary graphs but spends
+milliseconds per superstep in random gathers — TPU serializes them.
+Here every push/relabel superstep is ~20 fused dense ops on one
+[C, M+1] tile (row/col reductions, axis cumsums, elementwise masks):
+microseconds on the VPU, no gathers, no scatters, one compiled
+executable reused across rounds.
+
+The kernel is the same synchronous Goldberg-Tarjan cost-scaling
+push-relabel as the general solver (costs pre-scaled so eps=1 is exact;
+maximal pushes via in-row exclusive prefix sums; jump relabels), plus a
+Bellman-Ford price-tightening prelude which is EXACT here (the residual
+graph of the zero flow has diameter 2), so the eps=1 discharge follows
+shortest paths from the start.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_BIG = np.int32(1 << 30)
+_BIG_D = np.int32(1 << 28)
+
+
+@dataclass
+class LayeredProblem:
+    """The aggregate scheduling round, in class-by-machine form."""
+
+    supply: np.ndarray  # int32[C] unplaced live tasks per class
+    col_cap: np.ndarray  # int32[M] free slots per machine
+    cost_cm: np.ndarray  # int32[C, M] EC->machine arc cost per class
+    unsched_cost: int  # u: task->unsched arc cost
+    ec_cost: int  # e: task->EC arc cost
+
+
+@dataclass
+class LayeredResult:
+    y: np.ndarray  # int64[C, M] tasks of class c placed on machine m
+    num_unsched: int
+    objective: int  # in full-graph units: u*unplaced + sum((e+cost)*y)
+    supersteps: int
+
+
+def _pad_to(x: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full(size, fill, dtype=x.dtype)
+    out[: len(x)] = x
+    return out
+
+
+def _excesses(supply, y, z):
+    e_row = supply - jnp.sum(y, axis=1)
+    e_col = jnp.sum(y, axis=0) - z
+    e_sink = jnp.sum(z) - jnp.sum(supply)
+    return e_row, e_col, e_sink
+
+
+def transport_tighten(wS, U, col_cap):
+    """Shortest residual-cost distance to the sink for the zero flow
+    (all-forward residual graph, diameter 2 — exact in 2 sweeps).
+    Returns potentials (pr, pm, psink) = -d."""
+    i32 = jnp.int32
+    d_col = jnp.where(col_cap > 0, i32(0), jnp.int32(_BIG_D))
+    has_arc = U > 0
+    d_row = jnp.min(jnp.where(has_arc, wS + d_col[None, :], jnp.int32(_BIG_D)), axis=1)
+    d_row = jnp.minimum(d_row, jnp.int32(_BIG_D))
+    return -d_row, -jnp.minimum(d_col, jnp.int32(_BIG_D)), i32(0)
+
+
+def transport_saturate(wS, U, col_cap, y, z, pr, pm, psink):
+    i32 = jnp.int32
+    rcf = wS + pr[:, None] - pm[None, :]
+    y2 = jnp.where(rcf < 0, U, jnp.where(rcf > 0, i32(0), y))
+    rcs = pm - psink
+    z2 = jnp.where(rcs < 0, col_cap, jnp.where(rcs > 0, i32(0), z))
+    return y2, z2
+
+
+def transport_superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps):
+    """One synchronous push/relabel wave over the dense bipartite
+    residual graph. A fixed point once no node has positive excess, so
+    it is safe to run under a fixed trip count (lax.fori_loop)."""
+    i32 = jnp.int32
+    big = jnp.int32(_BIG)
+    e_row, e_col, e_sink = _excesses(supply, y, z)
+    rcf = wS + pr[:, None] - pm[None, :]
+
+    # --- rows push forward along admissible arcs (maximal push via
+    # in-row exclusive prefix sums) ---
+    r_fwd = U - y
+    adm_f = (r_fwd > 0) & (rcf < 0)
+    r_adm = jnp.where(adm_f, r_fwd, i32(0))
+    excl = jnp.cumsum(r_adm, axis=1) - r_adm
+    delta_f = jnp.clip(e_row[:, None] - excl, 0, r_adm)
+
+    # --- columns push: entry 0 = col->sink, entries 1..C = backward
+    # col->row (returning flow) ---
+    r_s = col_cap - z
+    rc_s = pm - psink
+    r_b = y  # backward residual col->row
+    rc_b = pm[None, :] - pr[:, None] - wS  # cost of bwd arc is -wS
+    colA = jnp.concatenate(
+        [
+            jnp.where((r_s > 0) & (rc_s < 0), r_s, i32(0))[None, :],
+            jnp.where((r_b > 0) & (rc_b < 0), r_b, i32(0)),
+        ],
+        axis=0,
+    )  # [1+C, Mp1], allocation order: sink first, then rows
+    exclA = jnp.cumsum(colA, axis=0) - colA
+    deltaA = jnp.clip(e_col[None, :] - exclA, 0, colA)
+    delta_s = deltaA[0]
+    delta_b = deltaA[1:]
+
+    # --- sink pushes back (transient positive excess after a
+    # saturate): backward sink->col arcs, residual z, cost 0 ---
+    r_zb = z
+    rc_zb = psink - pm
+    zb_adm = jnp.where((r_zb > 0) & (rc_zb < 0), r_zb, i32(0))
+    excl_zb = jnp.cumsum(zb_adm) - zb_adm
+    delta_zb = jnp.clip(e_sink - excl_zb, 0, zb_adm)
+
+    y2 = y + delta_f - delta_b
+    z2 = z + delta_s - delta_zb
+
+    # --- jump relabels for active nodes that pushed nothing ---
+    pushed_row = jnp.sum(delta_f, axis=1)
+    cand_row = jnp.where(r_fwd > 0, pm[None, :] - wS, -big)
+    best_row = jnp.max(cand_row, axis=1)
+    relabel_row = (e_row > 0) & (pushed_row == 0)
+    pr2 = jnp.where(relabel_row, best_row - eps, pr)
+
+    pushed_col = delta_s + jnp.sum(delta_b, axis=0)
+    cand_col = jnp.maximum(
+        jnp.max(jnp.where(y > 0, pr[:, None] + wS, -big), axis=0),
+        jnp.where(r_s > 0, psink, -big),
+    )
+    relabel_col = (e_col > 0) & (pushed_col == 0)
+    pm2 = jnp.where(relabel_col, cand_col - eps, pm)
+
+    pushed_sink = jnp.sum(delta_zb)
+    cand_sink = jnp.max(jnp.where(z > 0, pm, -big))
+    relabel_sink = (e_sink > 0) & (pushed_sink == 0)
+    psink2 = jnp.where(relabel_sink, cand_sink - eps, psink)
+    return y2, z2, pr2, pm2, psink2
+
+
+def solve_single_class(w, supply, col_cap):
+    """EXACT closed form for the C=1 transportation row (the trivial
+    cost model's shape, and the Google-trace / quincy-base shape): sort
+    columns by cost and greedily fill strictly-profitable capacity.
+
+    Exchange argument: any optimal solution places exactly
+    min(supply, sum of capacity at w<0) units, on the cheapest such
+    capacity; ties at w==0 are objective-neutral (left unscheduled).
+    One sort + one cumsum — no iterations, no convergence concerns.
+
+    w, col_cap: int32[Mp1]; returns y int32[Mp1].
+    """
+    i32 = jnp.int32
+    take = jnp.where(w < 0, col_cap, i32(0))
+    order = jnp.argsort(w)
+    take_s = take[order]
+    excl = jnp.cumsum(take_s) - take_s
+    y_s = jnp.clip(supply - excl, 0, take_s)
+    inv = jnp.argsort(order)
+    return y_s[inv]
+
+
+def solve_single_class_np(w: np.ndarray, supply: int, col_cap: np.ndarray) -> np.ndarray:
+    """Host (numpy) twin of solve_single_class."""
+    take = np.where(w < 0, col_cap, 0).astype(np.int64)
+    order = np.argsort(w, kind="stable")
+    take_s = take[order]
+    excl = np.cumsum(take_s) - take_s
+    y_s = np.clip(supply - excl, 0, take_s)
+    y = np.empty_like(y_s)
+    y[order] = y_s
+    return y
+
+
+def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
+    """Fixed-trip-count transport solve, embeddable in larger jitted
+    programs (no data-dependent control flow).
+
+    C == 1: the exact closed form (solve_single_class) — O(sort(M)).
+    C >= 2: the full cost-scaling phase schedule under lax.fori_loop —
+    each iteration either runs a superstep (when active nodes exist) or
+    advances the eps phase; after the eps=1 phase drains it is a fixed
+    point, so extra iterations are no-ops. Returns (y, converged).
+    """
+    C, Mp1 = wS.shape
+    i32 = jnp.int32
+    if C == 1:
+        y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
+        return y, jnp.bool_(True)
+
+    U = jnp.minimum(supply[:, None], col_cap[None, :])
+    pr0, pm0, psink0 = transport_tighten(wS, U, col_cap)
+    y0 = jnp.zeros((C, Mp1), i32)
+    z0 = jnp.zeros((Mp1,), i32)
+    eps0 = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
+
+    def body(_, s):
+        y, z, pr, pm, psink, eps, done = s
+        e_row, e_col, e_sink = _excesses(supply, y, z)
+        active = jnp.any(e_row > 0) | jnp.any(e_col > 0) | (e_sink > 0)
+        ys, zs, prs, pms, psinks = transport_superstep(
+            wS, U, supply, col_cap, y, z, pr, pm, psink, eps
+        )
+        finished = done | (~active & (eps <= 1))
+        new_eps = jnp.where(active | finished, eps, jnp.maximum(i32(1), eps // alpha))
+        yp, zp = transport_saturate(wS, U, col_cap, y, z, pr, pm, psink)
+        step = active & ~finished
+        phase = ~active & ~finished
+        return (
+            jnp.where(step, ys, jnp.where(phase, yp, y)),
+            jnp.where(step, zs, jnp.where(phase, zp, z)),
+            jnp.where(step, prs, pr),
+            jnp.where(step, pms, pm),
+            jnp.where(step, psinks, psink),
+            new_eps,
+            finished,
+        )
+
+    y, z, pr, pm, psink, eps, done = lax.fori_loop(
+        0, num_supersteps, body,
+        (y0, z0, pr0, pm0, psink0, eps0, jnp.bool_(False)),
+    )
+    e_row, e_col, e_sink = _excesses(supply, y, z)
+    max_abs = jnp.maximum(
+        jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
+    )
+    return y, done & (max_abs == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
+def _solve_transport(
+    wS,  # int32[C, Mp1] scaled costs (column Mp1-1 = unsched, 0)
+    supply,  # int32[C]
+    col_cap,  # int32[Mp1]
+    eps_init,  # int32 scalar
+    alpha: int = 8,
+    max_supersteps: int = 20_000,
+):
+    C, Mp1 = wS.shape
+    i32 = jnp.int32
+    U = jnp.minimum(supply[:, None], col_cap[None, :])  # fwd arc capacity
+
+    def phase_cond(state):
+        *_rest, steps, done = state
+        return ~done & (steps < max_supersteps)
+
+    def phase_body(state):
+        y, z, pr, pm, psink, eps, steps, done = state
+        e_row, e_col, e_sink = _excesses(supply, y, z)
+        any_active = jnp.any(e_row > 0) | jnp.any(e_col > 0) | (e_sink > 0)
+
+        def do_step(_):
+            y2, z2, pr2, pm2, psink2 = transport_superstep(
+                wS, U, supply, col_cap, y, z, pr, pm, psink, eps
+            )
+            return y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False)
+
+        def next_phase(_):
+            finished = eps <= 1
+            new_eps = jnp.maximum(i32(1), eps // alpha)
+            y2, z2 = transport_saturate(wS, U, col_cap, y, z, pr, pm, psink)
+            return (
+                jnp.where(finished, y, y2),
+                jnp.where(finished, z, z2),
+                pr, pm, psink,
+                jnp.where(finished, eps, new_eps),
+                steps,
+                finished,
+            )
+
+        return lax.cond(any_active, do_step, next_phase, operand=None)
+
+    pr0, pm0, psink0 = transport_tighten(wS, U, col_cap)
+    y0 = jnp.zeros((C, Mp1), i32)
+    z0 = jnp.zeros((Mp1,), i32)
+    state = (y0, z0, pr0, pm0, psink0, eps_init, i32(0), jnp.bool_(False))
+    y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
+        phase_cond, phase_body, state
+    )
+    e_row, e_col, e_sink = _excesses(supply, y, z)
+    max_abs = jnp.maximum(
+        jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
+    )
+    converged = done & (max_abs == 0)
+    return y, steps, converged
+
+
+class LayeredTransportSolver:
+    """The bulk scheduler's production TPU backend.
+
+    Not a generic FlowSolver: it understands only the aggregate layered
+    topology (which is the one BulkCluster builds) and is dispatched via
+    ``solve_layered`` — BulkCluster picks this fast path whenever its
+    backend provides the method, and otherwise falls back to the generic
+    FlowProblem seam (the same graceful dispatch the reference has
+    between full and incremental solver modes, placement/solver.go:60-90).
+    """
+
+    def __init__(self, alpha: int = 8, max_supersteps: int = 20_000):
+        self.alpha = alpha
+        self.max_supersteps = max_supersteps
+        self.last_supersteps = 0
+
+    def reset(self) -> None:
+        pass
+
+    def solve_layered(self, lp: LayeredProblem) -> LayeredResult:
+        C, M = lp.cost_cm.shape
+        supply = lp.supply.astype(np.int64)
+        total = int(supply.sum())
+        if total == 0:
+            self.last_supersteps = 0
+            return LayeredResult(
+                y=np.zeros((C, M), np.int64), num_unsched=0, objective=0, supersteps=0
+            )
+        # Shifted per-unit cost: placing costs (e + cost[c,m]), leaving
+        # unscheduled costs u; subtract u so the unsched column is 0.
+        w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - int(lp.unsched_cost)
+        # Pad machines to a lane-friendly multiple of 128, then append
+        # the unsched column (cap = total supply, cost 0).
+        Mp = ((M + 1 + 127) // 128) * 128
+        wP = np.zeros((C, Mp), np.int64)
+        wP[:, :M] = w
+        wP[:, M:] = 0  # padding columns have cap 0; last col = unsched
+        col_cap = np.zeros(Mp, np.int64)
+        col_cap[:M] = lp.col_cap
+        col_cap[-1] = total
+
+        n_scale = 1
+        while n_scale < C + Mp + 2:
+            n_scale <<= 1
+        max_w = int(np.abs(wP).max())
+        if max_w * n_scale >= (1 << 30):
+            raise OverflowError(
+                f"scaled layered costs overflow int32: max|w|={max_w} * {n_scale}"
+            )
+        wS = (wP * n_scale).astype(np.int32)
+
+        if C == 1:
+            # Exact closed form, pure host numpy: sort + greedy fill of
+            # strictly-profitable capacity (see solve_single_class).
+            y_np = solve_single_class_np(wP[0], total, col_cap)[None, :]
+            self.last_supersteps = 0
+        else:
+            # Multi-class: cost-scaling push-relabel on device. Start the
+            # schedule at eps = n_scale (one original cost unit): valid
+            # for any eps0 since tightened potentials make the zero flow
+            # 0-optimal, and measurably ~2-3x fewer supersteps than
+            # starting from max|w| on contended instances. Fall back to
+            # the full-range schedule if the short one stalls.
+            eps_full = np.int32(max(1, max_w * n_scale))
+            wS_d = jnp.asarray(wS)
+            sup_d = jnp.asarray(supply.astype(np.int32))
+            cap_d = jnp.asarray(col_cap.astype(np.int32))
+            attempts = [
+                (np.int32(n_scale), self.max_supersteps),
+                (eps_full, self.max_supersteps),
+            ]
+            y = steps = None
+            converged = False
+            for eps_init, cap_steps in attempts:
+                y, steps, converged = _solve_transport(
+                    wS_d, sup_d, cap_d, jnp.asarray(eps_init),
+                    alpha=self.alpha,
+                    max_supersteps=cap_steps,
+                )
+                if bool(converged):
+                    break
+            self.last_supersteps = int(steps)
+            if not bool(converged):
+                raise RuntimeError(
+                    f"layered transport solve did not converge in "
+                    f"{self.max_supersteps} supersteps"
+                )
+            y_np = np.asarray(y).astype(np.int64)
+        y_real = y_np[:, :M]
+        placed = int(y_real.sum())
+        objective = int(lp.unsched_cost) * (total - placed) + int(
+            ((lp.cost_cm.astype(np.int64) + int(lp.ec_cost)) * y_real).sum()
+        )
+        return LayeredResult(
+            y=y_real,
+            num_unsched=total - placed,
+            objective=objective,
+            supersteps=self.last_supersteps,
+        )

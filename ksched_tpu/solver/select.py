@@ -34,4 +34,8 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         from .cpu_ref import ReferenceSolver
 
         return ReferenceSolver()
-    raise ValueError(f"unknown backend {name!r}; want native | jax | ref")
+    if name == "layered":
+        from .layered import LayeredTransportSolver
+
+        return LayeredTransportSolver()
+    raise ValueError(f"unknown backend {name!r}; want native | jax | ref | layered")

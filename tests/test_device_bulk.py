@@ -1,0 +1,122 @@
+"""Device-resident cluster (scheduler/device_bulk.py): behavioral parity
+with the host BulkCluster, state invariants, steady-round chains, and
+elastic membership — all on the CPU backend (conftest forces
+JAX_PLATFORMS=cpu), the same code path the TPU runs."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ksched_tpu.scheduler.bulk import BulkCluster
+from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
+from ksched_tpu.solver.layered import LayeredTransportSolver
+
+
+def _pair(C, M=12, jobs=3, seed=9, unsched_cost=25):
+    cost = np.random.default_rng(seed).integers(0, 20, (C, M)).astype(np.int32)
+    cost_d = jnp.asarray(cost)
+    host = BulkCluster(
+        num_machines=M, pus_per_machine=2, slots_per_pu=2, num_jobs=jobs,
+        backend=LayeredTransportSolver(), task_capacity=256,
+        num_task_classes=C, class_cost_fn=lambda cl: cost, unsched_cost=unsched_cost,
+    )
+    dev = DeviceBulkCluster(
+        num_machines=M, pus_per_machine=2, slots_per_pu=2, num_jobs=jobs,
+        num_task_classes=C, task_capacity=256,
+        class_cost_fn=lambda census: cost_d, unsched_cost=unsched_cost,
+    )
+    return host, dev
+
+
+@pytest.mark.parametrize("C", [1, 2])
+def test_device_matches_host_over_churn_rounds(C):
+    host, dev = _pair(C)
+    rng = np.random.default_rng(3)
+    jobs = rng.integers(0, 3, 100).astype(np.int32)
+    cls = rng.integers(0, C, 100).astype(np.int32)
+    host.add_tasks(100, jobs, cls)
+    dev.add_tasks(100, jobs, cls)
+    for i in range(5):
+        rh = host.round()
+        sd = dev.fetch_stats(dev.round())
+        assert bool(sd["converged"])
+        assert len(rh.placed_tasks) == int(sd["placed"])
+        assert rh.num_unscheduled == int(sd["unscheduled"])
+        st = dev.fetch_state()
+        ph = np.nonzero(host.task_live & (host.task_pu >= 0))[0]
+        pd = np.nonzero(np.asarray(st["live"]) & (np.asarray(st["pu"]) >= 0))[0]
+        common = np.intersect1d(ph, pd)
+        done = rng.choice(common, 8, replace=False)
+        host.complete_tasks((host.task0 + done).astype(np.int32))
+        dev.complete_tasks(done.astype(np.int32))
+        nj = rng.integers(0, 3, 5).astype(np.int32)
+        nc = rng.integers(0, C, 5).astype(np.int32)
+        host.add_tasks(5, nj, nc)
+        dev.add_tasks(5, nj, nc)
+    st = dev.fetch_state()
+    live = np.asarray(st["live"])
+    pu = np.asarray(st["pu"])
+    recount = np.bincount(pu[live & (pu >= 0)], minlength=dev.num_pus)
+    assert (recount == np.asarray(st["pu_running"])).all()
+    assert (np.asarray(st["pu_running"]) <= dev.S).all()
+
+
+def test_device_steady_round_chain_consistency():
+    """A scan of steady rounds must keep supply conservation: every
+    round converges, placed+unscheduled equals that round's demand, and
+    the final state's occupancy must be internally consistent."""
+    dev = DeviceBulkCluster(
+        num_machines=20, pus_per_machine=2, slots_per_pu=2, num_jobs=4,
+        num_task_classes=1, task_capacity=256,
+    )
+    rng = np.random.default_rng(0)
+    dev.add_tasks(60, rng.integers(0, 4, 60).astype(np.int32))
+    s = dev.fetch_stats(dev.round())
+    assert bool(s["converged"]) and int(s["placed"]) == 60
+
+    stats = dev.fetch_stats(dev.run_steady_rounds(20, churn_prob=0.05, arrivals=3, seed=7))
+    assert stats["converged"].all()
+    # each round's demand is fully accounted: placed + unscheduled
+    assert (stats["placed"] + stats["unscheduled"] >= 0).all()
+    st = dev.fetch_state()
+    live = np.asarray(st["live"])
+    pu = np.asarray(st["pu"])
+    recount = np.bincount(pu[live & (pu >= 0)], minlength=dev.num_pus)
+    assert (recount == np.asarray(st["pu_running"])).all()
+    assert (np.asarray(st["pu_running"]) <= dev.S).all()
+    assert int(live.sum()) == int(stats["live"][-1])
+
+
+def test_device_machine_loss_and_rejoin():
+    dev = DeviceBulkCluster(
+        num_machines=4, pus_per_machine=1, slots_per_pu=2, num_jobs=1,
+        num_task_classes=1, task_capacity=64, unsched_cost=100,
+    )
+    dev.add_tasks(8)
+    s = dev.fetch_stats(dev.round())
+    assert int(s["placed"]) == 8
+    dev.set_machine_enabled(0, False)
+    s2 = dev.fetch_stats(dev.round())
+    # 2 evicted tasks compete for 6 remaining slots (all full) -> unsched
+    assert int(s2["unscheduled"]) == 2
+    st = dev.fetch_state()
+    pu = np.asarray(st["pu"])
+    live = np.asarray(st["live"])
+    assert not ((pu[live] >= 0) & (pu[live] < dev.P)).any(), "machine 0 still hosts tasks"
+    dev.set_machine_enabled(0, True)
+    s3 = dev.fetch_stats(dev.round())
+    assert int(s3["placed"]) == 2 and int(s3["unscheduled"]) == 0
+
+
+def test_device_overflow_goes_unscheduled():
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=2, num_jobs=1,
+        num_task_classes=1, task_capacity=64,
+    )
+    dev.add_tasks(10)
+    s = dev.fetch_stats(dev.round())
+    assert int(s["placed"]) == 4
+    assert int(s["unscheduled"]) == 6
+    # objective: 4 placed at (e=2) + 6 unsched at (u=5)
+    assert int(s["objective"]) == 4 * 2 + 6 * 5

@@ -1,0 +1,163 @@
+"""The dense layered transport solver (solver/layered.py): exactness
+against the SSP oracle, and BulkCluster fast-path equivalence.
+
+The layered solver must produce the SAME objective as the generic MCMF
+backends on the aggregate topology (placement parity in the reference's
+sense: MCMF has many optima, so parity = equal objective cost —
+SURVEY.md §7 "Hard parts").
+"""
+
+import numpy as np
+import pytest
+
+from ksched_tpu.scheduler.bulk import BulkCluster
+from ksched_tpu.solver.cpu_ref import ReferenceSolver
+from ksched_tpu.solver.layered import (
+    LayeredProblem,
+    LayeredTransportSolver,
+)
+
+
+def _objective_via_oracle(cluster: BulkCluster) -> int:
+    """Solve the cluster's full FlowProblem with the exact SSP oracle."""
+    cluster._refresh_capacities()
+    problem = cluster._problem()
+    return ReferenceSolver().solve(problem).objective
+
+
+def _make_cluster(backend, C, M=12, jobs=3, seed=7, unsched_cost=25):
+    rng = np.random.default_rng(seed)
+    cost = rng.integers(0, 20, (C, M)).astype(np.int32)
+    return BulkCluster(
+        num_machines=M,
+        pus_per_machine=2,
+        slots_per_pu=2,
+        num_jobs=jobs,
+        backend=backend,
+        task_capacity=256,
+        num_task_classes=C,
+        class_cost_fn=lambda cl: cost,
+        unsched_cost=unsched_cost,
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("C", [1, 3])
+def test_layered_objective_matches_oracle(seed, C):
+    rng = np.random.default_rng(seed)
+    solver = LayeredTransportSolver()
+    cluster = _make_cluster(solver, C=C, seed=seed)
+    n = int(rng.integers(10, 120))
+    cluster.add_tasks(
+        n,
+        rng.integers(0, 3, n).astype(np.int32),
+        rng.integers(0, C, n).astype(np.int32),
+    )
+    want = _objective_via_oracle(cluster)
+
+    cluster._refresh_capacities()
+    unplaced = np.nonzero(cluster.task_live & (cluster.task_pu < 0))[0]
+    supply = np.bincount(cluster.task_class[unplaced], minlength=C).astype(np.int32)
+    pu_free = cluster.S - cluster.pu_running
+    machine_free = pu_free.reshape(cluster.M, cluster.P).sum(axis=1)
+    cost_cm = cluster.cost[
+        cluster.a_ecm0 : cluster.a_ecm0 + C * cluster.M
+    ].reshape(C, cluster.M)
+    res = solver.solve_layered(
+        LayeredProblem(
+            supply=supply,
+            col_cap=machine_free.astype(np.int32),
+            cost_cm=cost_cm,
+            unsched_cost=cluster.unsched_cost,
+            ec_cost=cluster.ec_cost,
+        )
+    )
+    assert res.objective == want
+
+
+def test_bulk_fast_path_matches_generic_over_rounds():
+    """Multi-round churn: the layered fast path and the generic oracle
+    path must place the same number of tasks every round and end with
+    consistent capacity accounting."""
+
+    def drive(backend):
+        rng = np.random.default_rng(3)
+        cluster = _make_cluster(backend, C=2, seed=11)
+        cluster.add_tasks(
+            100, rng.integers(0, 3, 100).astype(np.int32),
+            rng.integers(0, 2, 100).astype(np.int32),
+        )
+        history = []
+        for i in range(6):
+            r = cluster.round()
+            history.append((len(r.placed_tasks), r.num_unscheduled))
+            placed = np.nonzero(cluster.task_live & (cluster.task_pu >= 0))[0]
+            if len(placed) >= 8:
+                done = rng.choice(placed, 8, replace=False)
+                cluster.complete_tasks((cluster.task0 + done).astype(np.int32))
+            cluster.add_tasks(
+                5, rng.integers(0, 3, 5).astype(np.int32),
+                rng.integers(0, 2, 5).astype(np.int32),
+            )
+        return history, cluster
+
+    h_ref, _ = drive(ReferenceSolver())
+    h_fast, cluster = drive(LayeredTransportSolver())
+    assert h_ref == h_fast
+    live_placed = cluster.task_live & (cluster.task_pu >= 0)
+    recount = np.bincount(
+        cluster.task_pu[live_placed], minlength=cluster.num_pus
+    )
+    assert (recount == cluster.pu_running).all()
+    assert (cluster.pu_running <= cluster.S).all()
+
+
+def test_layered_machine_loss_reschedules():
+    """Elastic membership through the fast path: disabling a machine
+    evicts its tasks and the next round re-places them elsewhere."""
+    solver = LayeredTransportSolver()
+    cluster = _make_cluster(solver, C=1, M=4, jobs=1, unsched_cost=100)
+    cluster.add_tasks(8)
+    r = cluster.round()
+    assert len(r.placed_tasks) == 8
+    victim = int(cluster.task_pu[cluster.task_pu >= 0][0] // cluster.P)
+    evicted = cluster.set_machine_enabled(victim, False)
+    assert len(evicted) >= 1
+    r2 = cluster.round()
+    assert len(r2.placed_tasks) == len(evicted)
+    lo, hi = victim * cluster.P, (victim + 1) * cluster.P
+    on_victim = (cluster.task_pu >= lo) & (cluster.task_pu < hi) & cluster.task_live
+    assert not on_victim.any()
+
+
+def test_layered_prefers_cheap_machines():
+    """With a steep cost gradient and scarce tasks, every placement must
+    land on the cheapest machines (exactness, not just feasibility)."""
+    solver = LayeredTransportSolver()
+    M = 8
+    cost = (np.arange(M, dtype=np.int32) * 10)[None, :]  # machine m costs 10m
+    cluster = BulkCluster(
+        num_machines=M, pus_per_machine=1, slots_per_pu=2, num_jobs=1,
+        backend=solver, task_capacity=64, num_task_classes=1,
+        class_cost_fn=lambda cl: cost, unsched_cost=1000,
+    )
+    cluster.add_tasks(4)  # 4 tasks, 2 slots per machine -> machines 0,1
+    r = cluster.round()
+    machines = (r.placed_pus - cluster.pu0) // cluster.P
+    assert sorted(machines.tolist()) == [0, 0, 1, 1]
+
+
+def test_layered_unsched_when_placement_too_expensive():
+    """Tasks stay unscheduled when u < e + cost (the escape-arc policy,
+    reference trivial_cost_modeler.go:41-43)."""
+    solver = LayeredTransportSolver()
+    cost = np.full((1, 4), 50, np.int32)
+    cluster = BulkCluster(
+        num_machines=4, pus_per_machine=1, slots_per_pu=4, num_jobs=1,
+        backend=solver, task_capacity=64, num_task_classes=1,
+        class_cost_fn=lambda cl: cost, unsched_cost=5,
+    )
+    cluster.add_tasks(10)
+    r = cluster.round()
+    assert len(r.placed_tasks) == 0
+    assert r.num_unscheduled == 10
