@@ -23,13 +23,7 @@ import numpy as np
 
 from .changes import AddNodeChange, Change, ChangeArcChange, NewArcChange, RemoveNodeChange
 from .flowgraph import FlowGraph, NodeType
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
+from ..utils import next_pow2
 
 
 @dataclass
@@ -83,8 +77,8 @@ class DeviceGraphState:
     # -- construction -----------------------------------------------------
 
     def _alloc(self, n: int, m: int) -> None:
-        self.n_cap = max(_next_pow2(n), 16)
-        self.m_cap = max(_next_pow2(m), 16)
+        self.n_cap = max(next_pow2(n), 16)
+        self.m_cap = max(next_pow2(m), 16)
         self.excess = np.zeros(self.n_cap, dtype=np.int64)
         self.node_type = np.full(self.n_cap, -1, dtype=np.int8)
         self.src = np.zeros(self.m_cap, dtype=np.int32)
@@ -111,7 +105,7 @@ class DeviceGraphState:
     # -- incremental updates ----------------------------------------------
 
     def _grow_nodes(self, need: int) -> None:
-        new_cap = _next_pow2(need)
+        new_cap = next_pow2(need)
         if new_cap <= self.n_cap:
             return
         self.excess = np.concatenate([self.excess, np.zeros(new_cap - self.n_cap, np.int64)])
@@ -122,7 +116,7 @@ class DeviceGraphState:
         self.generation += 1
 
     def _grow_arcs(self, need: int) -> None:
-        new_cap = _next_pow2(need)
+        new_cap = next_pow2(need)
         if new_cap <= self.m_cap:
             return
         pad = new_cap - self.m_cap

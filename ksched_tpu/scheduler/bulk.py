@@ -43,13 +43,7 @@ import numpy as np
 
 from ..graph.device_export import FlowProblem
 from ..solver.base import FlowSolver
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
+from ..utils import next_pow2
 
 
 @dataclass
@@ -98,7 +92,7 @@ class BulkCluster:
         self.sink = self.pu0 + self.num_pus
         self.task0 = self.sink + 1
 
-        self.n_cap = _next_pow2(self.task0 + task_capacity)
+        self.n_cap = next_pow2(self.task0 + task_capacity)
         self.task_cap = self.n_cap - self.task0
 
         # Static arc slots: EC->machine (C*M, class-major), machine->PU
@@ -110,7 +104,7 @@ class BulkCluster:
         self.a_unsink0 = self.a_pusink0 + self.num_pus
         self.a_task0 = self.a_unsink0 + num_jobs
         self.arcs_per_task = 1 + C
-        self.m_cap = _next_pow2(self.a_task0 + self.arcs_per_task * self.task_cap)
+        self.m_cap = next_pow2(self.a_task0 + self.arcs_per_task * self.task_cap)
 
         self.src = np.zeros(self.m_cap, np.int32)
         self.dst = np.zeros(self.m_cap, np.int32)

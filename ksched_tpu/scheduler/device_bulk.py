@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..solver.layered import transport_fori
+from ..solver.layered import COST_SCALE_LIMIT, pad_geometry, transport_fori
 
 
 class DeviceClusterState(NamedTuple):
@@ -77,18 +77,15 @@ class DeviceBulkCluster:
         self.ec_cost = int(ec_cost)
         self.class_cost_fn = class_cost_fn
         # C == 1 uses the exact closed form (no iterations); C >= 2 runs
-        # the cost-scaling schedule, which needs a generous fixed budget.
+        # the cost-scaling schedule under a lax.while_loop that exits on
+        # convergence — this is only the safety bound, not the cost.
         self.supersteps = int(
             supersteps if supersteps is not None
             else (1 if num_task_classes == 1 else 16384)
         )
 
         # Padded transport columns: [machines | zero-cap padding | unsched]
-        self.Mp = ((num_machines + 1 + 127) // 128) * 128
-        n_scale = 1
-        while n_scale < self.C + self.Mp + 2:
-            n_scale <<= 1
-        self.n_scale = n_scale
+        self.Mp, self.n_scale = pad_geometry(num_machines, num_task_classes)
 
         self.state = DeviceClusterState(
             live=jnp.zeros(self.Tcap, jnp.bool_),
@@ -100,6 +97,7 @@ class DeviceBulkCluster:
         )
         self._build_programs()
         self.last_stats: Optional[dict] = None
+        self.last_admitted = None  # device i32 from the latest add_tasks
 
     # ------------------------------------------------------------------
     # jitted programs (closures over the static geometry)
@@ -142,6 +140,13 @@ class DeviceBulkCluster:
             else:
                 cost_cm = jnp.zeros((C, M), i32)
             w = cost_cm + i32(e_cost) - i32(u_cost)
+            # int32 headroom guard: the host solver raises OverflowError
+            # for the same condition (solver/layered.py solve_layered);
+            # in a jitted round we can only flag it — surfaced in stats
+            # and asserted by fetch_stats.
+            cost_overflow = jnp.max(jnp.abs(w)) >= i32(
+                COST_SCALE_LIMIT // n_scale
+            )
 
             wS = jnp.zeros((C, Mp), i32).at[:, :M].set(w * i32(n_scale))
             col_cap = (
@@ -196,6 +201,7 @@ class DeviceBulkCluster:
                 "placed": placed_count,
                 "unscheduled": total - jnp.sum(y_real),
                 "converged": converged,
+                "cost_overflow": cost_overflow,
                 "objective": objective,
                 "live": jnp.sum(state.live, dtype=i32),
             }
@@ -203,16 +209,20 @@ class DeviceBulkCluster:
 
         def admit(state: DeviceClusterState, jobs, classes, count):
             """Occupy the first `count` free rows with the first `count`
-            entries of (jobs, classes)."""
+            entries of (jobs, classes). Returns (state, admitted):
+            admitted < count when the task pool is exhausted — the host
+            BulkCluster raises for this; here the shortfall is reported
+            so add_tasks can check it after fetch."""
             free_rank = jnp.cumsum(~state.live) - 1  # rank among free rows
             newmask = ~state.live & (free_rank < count)
             src_idx = jnp.clip(free_rank, 0, Tcap - 1)
+            admitted = jnp.sum(newmask, dtype=i32)
             return state._replace(
                 live=state.live | newmask,
                 cls=jnp.where(newmask, classes[src_idx].astype(i32), state.cls),
                 job=jnp.where(newmask, jobs[src_idx].astype(i32), state.job),
                 pu=jnp.where(newmask, i32(-1), state.pu),
-            )
+            ), admitted
 
         def complete(state: DeviceClusterState, rows, count):
             """Retire `count` task rows (first `count` entries of `rows`)."""
@@ -241,10 +251,11 @@ class DeviceBulkCluster:
                 & (state.pu >= 0)
                 & ((jnp.clip(state.pu, 0, num_pus - 1) // P) == machine_index)
             )
-            evict = on_machine & ~enabled
+            disabled = jnp.bool_(not enabled)
+            evict = on_machine & disabled
             pu_mask = (jnp.arange(num_pus, dtype=i32) // P) == machine_index
             pu_running = jnp.where(
-                pu_mask & ~enabled, i32(0), state.pu_running
+                pu_mask & disabled, i32(0), state.pu_running
             )
             return state._replace(
                 machine_enabled=me,
@@ -286,8 +297,10 @@ class DeviceBulkCluster:
                 ),
                 pu=jnp.where(newmask, i32(-1), state.pu),
             )
+            admitted = jnp.sum(newmask, dtype=i32)
             state, stats = round_core(state)
             stats["completed"] = jnp.sum(done, dtype=i32)
+            stats["admitted"] = admitted
             return state, stats
 
         self._round_jit = jax.jit(round_core)
@@ -310,13 +323,19 @@ class DeviceBulkCluster:
     # ------------------------------------------------------------------
 
     def add_tasks(self, count, job_ids=None, classes=None) -> None:
+        """Admit up to `count` tasks. The admitted count is kept on
+        device in ``last_admitted`` (fetching it mid-run would poison
+        dispatch latency on tunneled TPUs — see bench.py); callers that
+        need the host BulkCluster's pool-exhausted error should check
+        ``int(jax.device_get(self.last_admitted)) == count`` at a safe
+        point."""
         jobs = np.zeros(self.Tcap, np.int32)
         cls = np.zeros(self.Tcap, np.int32)
         if job_ids is not None:
             jobs[: len(job_ids)] = job_ids
         if classes is not None:
             cls[: len(classes)] = classes
-        self.state = self._admit_jit(
+        self.state, self.last_admitted = self._admit_jit(
             self.state, jnp.asarray(jobs), jnp.asarray(cls), jnp.int32(count)
         )
 
@@ -357,7 +376,14 @@ class DeviceBulkCluster:
 
     def fetch_stats(self, stats=None) -> dict:
         got = jax.device_get(stats if stats is not None else self.last_stats)
-        return {k: np.asarray(v) for k, v in got.items()}
+        out = {k: np.asarray(v) for k, v in got.items()}
+        if "cost_overflow" in out and bool(np.any(out["cost_overflow"])):
+            raise OverflowError(
+                "scaled layered costs overflow int32 in a device round "
+                "(class_cost_fn values too large for "
+                f"n_scale={self.n_scale}); the solve result is invalid"
+            )
+        return out
 
     def fetch_state(self) -> dict:
         got = jax.device_get(self.state)

@@ -80,6 +80,25 @@ def _pad_to(x: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
+def pad_geometry(num_machines: int, num_classes: int) -> Tuple[int, int]:
+    """(Mp, n_scale) for the padded transport problem — shared by the
+    host path (solve_layered) and the device-resident path
+    (scheduler/device_bulk.py) so the two cannot drift.
+
+    Mp pads the machine axis to a lane-friendly multiple of 128 with
+    room for the unsched column; n_scale is the cost multiplier that
+    makes eps=1 termination exact (smallest pow2 > node count)."""
+    Mp = ((num_machines + 1 + 127) // 128) * 128
+    n_scale = 1
+    while n_scale < num_classes + Mp + 2:
+        n_scale <<= 1
+    return Mp, n_scale
+
+
+#: scaled costs must stay below 2^30 for int32 arithmetic headroom
+COST_SCALE_LIMIT = 1 << 30
+
+
 def _excesses(supply, y, z):
     e_row = supply - jnp.sum(y, axis=1)
     e_col = jnp.sum(y, axis=0) - z
@@ -210,73 +229,14 @@ def solve_single_class_np(w: np.ndarray, supply: int, col_cap: np.ndarray) -> np
     return y
 
 
-def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
-    """Fixed-trip-count transport solve, embeddable in larger jitted
-    programs (no data-dependent control flow).
-
-    C == 1: the exact closed form (solve_single_class) — O(sort(M)).
-    C >= 2: the full cost-scaling phase schedule under lax.fori_loop —
-    each iteration either runs a superstep (when active nodes exist) or
-    advances the eps phase; after the eps=1 phase drains it is a fixed
-    point, so extra iterations are no-ops. Returns (y, converged).
-    """
-    C, Mp1 = wS.shape
+def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps):
+    """The cost-scaling phase schedule as a bounded lax.while_loop:
+    each iteration either runs a superstep (while active nodes exist)
+    or advances the eps phase; exits as soon as the eps=1 phase drains
+    (early exit matters — a converged multi-class solve typically takes
+    tens of supersteps against a bound of thousands). Legal inside jit
+    and inside lax.scan bodies. Returns (y, z, steps, converged)."""
     i32 = jnp.int32
-    if C == 1:
-        y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
-        return y, jnp.bool_(True)
-
-    U = jnp.minimum(supply[:, None], col_cap[None, :])
-    pr0, pm0, psink0 = transport_tighten(wS, U, col_cap)
-    y0 = jnp.zeros((C, Mp1), i32)
-    z0 = jnp.zeros((Mp1,), i32)
-    eps0 = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
-
-    def body(_, s):
-        y, z, pr, pm, psink, eps, done = s
-        e_row, e_col, e_sink = _excesses(supply, y, z)
-        active = jnp.any(e_row > 0) | jnp.any(e_col > 0) | (e_sink > 0)
-        ys, zs, prs, pms, psinks = transport_superstep(
-            wS, U, supply, col_cap, y, z, pr, pm, psink, eps
-        )
-        finished = done | (~active & (eps <= 1))
-        new_eps = jnp.where(active | finished, eps, jnp.maximum(i32(1), eps // alpha))
-        yp, zp = transport_saturate(wS, U, col_cap, y, z, pr, pm, psink)
-        step = active & ~finished
-        phase = ~active & ~finished
-        return (
-            jnp.where(step, ys, jnp.where(phase, yp, y)),
-            jnp.where(step, zs, jnp.where(phase, zp, z)),
-            jnp.where(step, prs, pr),
-            jnp.where(step, pms, pm),
-            jnp.where(step, psinks, psink),
-            new_eps,
-            finished,
-        )
-
-    y, z, pr, pm, psink, eps, done = lax.fori_loop(
-        0, num_supersteps, body,
-        (y0, z0, pr0, pm0, psink0, eps0, jnp.bool_(False)),
-    )
-    e_row, e_col, e_sink = _excesses(supply, y, z)
-    max_abs = jnp.maximum(
-        jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
-    )
-    return y, done & (max_abs == 0)
-
-
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
-def _solve_transport(
-    wS,  # int32[C, Mp1] scaled costs (column Mp1-1 = unsched, 0)
-    supply,  # int32[C]
-    col_cap,  # int32[Mp1]
-    eps_init,  # int32 scalar
-    alpha: int = 8,
-    max_supersteps: int = 20_000,
-):
-    C, Mp1 = wS.shape
-    i32 = jnp.int32
-    U = jnp.minimum(supply[:, None], col_cap[None, :])  # fwd arc capacity
 
     def phase_cond(state):
         *_rest, steps, done = state
@@ -308,6 +268,7 @@ def _solve_transport(
 
         return lax.cond(any_active, do_step, next_phase, operand=None)
 
+    C, Mp1 = wS.shape
     pr0, pm0, psink0 = transport_tighten(wS, U, col_cap)
     y0 = jnp.zeros((C, Mp1), i32)
     z0 = jnp.zeros((Mp1,), i32)
@@ -319,7 +280,44 @@ def _solve_transport(
     max_abs = jnp.maximum(
         jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
     )
-    converged = done & (max_abs == 0)
+    return y, z, steps, done & (max_abs == 0)
+
+
+def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
+    """Bounded transport solve, embeddable in larger jitted programs.
+
+    C == 1: the exact closed form (solve_single_class) — O(sort(M)).
+    C >= 2: the cost-scaling phase schedule (_transport_loop), exiting
+    as soon as it converges, bounded by num_supersteps.
+    Returns (y, converged).
+    """
+    C, Mp1 = wS.shape
+    i32 = jnp.int32
+    if C == 1:
+        y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
+        return y, jnp.bool_(True)
+
+    U = jnp.minimum(supply[:, None], col_cap[None, :])
+    eps0 = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
+    y, z, steps, converged = _transport_loop(
+        wS, U, supply, col_cap, eps0, alpha, num_supersteps
+    )
+    return y, converged
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
+def _solve_transport(
+    wS,  # int32[C, Mp1] scaled costs (column Mp1-1 = unsched, 0)
+    supply,  # int32[C]
+    col_cap,  # int32[Mp1]
+    eps_init,  # int32 scalar
+    alpha: int = 8,
+    max_supersteps: int = 20_000,
+):
+    U = jnp.minimum(supply[:, None], col_cap[None, :])  # fwd arc capacity
+    y, z, steps, converged = _transport_loop(
+        wS, U, supply, col_cap, eps_init, alpha, max_supersteps
+    )
     return y, steps, converged
 
 
@@ -356,7 +354,7 @@ class LayeredTransportSolver:
         w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - int(lp.unsched_cost)
         # Pad machines to a lane-friendly multiple of 128, then append
         # the unsched column (cap = total supply, cost 0).
-        Mp = ((M + 1 + 127) // 128) * 128
+        Mp, n_scale = pad_geometry(M, C)
         wP = np.zeros((C, Mp), np.int64)
         wP[:, :M] = w
         wP[:, M:] = 0  # padding columns have cap 0; last col = unsched
@@ -364,11 +362,8 @@ class LayeredTransportSolver:
         col_cap[:M] = lp.col_cap
         col_cap[-1] = total
 
-        n_scale = 1
-        while n_scale < C + Mp + 2:
-            n_scale <<= 1
         max_w = int(np.abs(wP).max())
-        if max_w * n_scale >= (1 << 30):
+        if max_w * n_scale >= COST_SCALE_LIMIT:
             raise OverflowError(
                 f"scaled layered costs overflow int32: max|w|={max_w} * {n_scale}"
             )

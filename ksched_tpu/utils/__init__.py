@@ -4,6 +4,7 @@ from .ids import (
     equiv_class_from_bytes,
     fnv1a_64,
     job_id_from_string,
+    next_pow2,
     rand_uint64,
     resource_id_from_string,
     rng,
@@ -18,6 +19,7 @@ __all__ = [
     "equiv_class_from_bytes",
     "fnv1a_64",
     "job_id_from_string",
+    "next_pow2",
     "rand_uint64",
     "resource_id_from_string",
     "rng",

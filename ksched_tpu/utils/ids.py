@@ -92,3 +92,12 @@ class SlotAllocator(IDGenerator):
 
     def __init__(self) -> None:
         super().__init__(start=0)
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1). Solver arrays grow by doubling
+    so XLA sees few distinct shapes (SURVEY.md: static-shape padding)."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

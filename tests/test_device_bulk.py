@@ -120,3 +120,33 @@ def test_device_overflow_goes_unscheduled():
     assert int(s["unscheduled"]) == 6
     # objective: 4 placed at (e=2) + 6 unsched at (u=5)
     assert int(s["objective"]) == 4 * 2 + 6 * 5
+
+
+def test_device_admit_shortfall_reported():
+    import jax
+
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=2, num_jobs=1,
+        num_task_classes=1, task_capacity=8,
+    )
+    dev.add_tasks(6)
+    assert int(jax.device_get(dev.last_admitted)) == 6
+    # pool has 2 free rows left; asking for 5 only admits 2
+    dev.add_tasks(5)
+    assert int(jax.device_get(dev.last_admitted)) == 2
+    assert dev.num_live_tasks == 8
+
+
+def test_device_cost_overflow_flagged():
+    huge = 1 << 24  # * n_scale (>= 2^7 here) overflows 2^30
+
+    def cost_fn(census):
+        return jnp.full((2, 2), huge, jnp.int32)
+
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=2, num_jobs=1,
+        num_task_classes=2, task_capacity=8, class_cost_fn=cost_fn,
+    )
+    dev.add_tasks(4, classes=np.array([0, 1, 0, 1], np.int32))
+    with pytest.raises(OverflowError):
+        dev.fetch_stats(dev.round())
