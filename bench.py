@@ -50,7 +50,8 @@ def _solver_work(backend) -> int:
 
 def run_device_bench(args) -> None:
     """The production path: device-resident cluster, rounds chained on
-    device in `--chunk`-round scans, one forcing fetch per chunk.
+    device in `--chunk`-round scans, one block_until_ready per chunk
+    (stats fetches deferred until after all timing — see below).
 
     The timed region per round matches the reference's (everything
     inside ScheduleAllJobs: stats refresh, graph update, solve, decode,
@@ -92,7 +93,7 @@ def run_device_bench(args) -> None:
     R = min(args.chunk, args.rounds)
     # warm the scan executable
     jax.block_until_ready(dev.run_steady_rounds(R, args.churn, churn_n, seed=1))
-    chunks = max(1, args.rounds // R)
+    chunks = max(1, -(-args.rounds // R))  # ceil: measure >= requested rounds
     per_round_ms = []
     chunk_stats = []
     for rep in range(chunks):

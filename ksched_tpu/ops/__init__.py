@@ -1,0 +1,92 @@
+"""Pallas TPU kernels for the solver hot ops, plus the dispatch switch.
+
+`set_pallas_mode` controls whether the layered-transport solve runs as
+the fused Pallas kernel (ops/transport_pallas.py) or the multi-op XLA
+path (solver/layered.py):
+
+- "auto" (default): Pallas on TPU backends, XLA elsewhere;
+- "on": always Pallas (compiled);
+- "interpret": always Pallas under the interpreter (CPU test envs);
+- "off": always the XLA path.
+
+The mode is read at TRACE time: it must be set before the consuming
+program is built (before constructing a DeviceBulkCluster, and before a
+solver's first solve). Already-compiled programs keep the dispatch they
+were traced with — rebuild the cluster/solver after switching modes.
+
+`jax.experimental.pallas.tpu` is imported lazily, only when a Pallas
+branch is actually taken, so XLA-only deployments never depend on the
+Pallas TPU lowerings being importable.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+_PALLAS_MODE = "auto"
+_VALID_MODES = ("auto", "on", "interpret", "off")
+
+
+def set_pallas_mode(mode: str) -> None:
+    if mode not in _VALID_MODES:
+        raise ValueError(f"pallas mode must be one of {_VALID_MODES}, got {mode!r}")
+    global _PALLAS_MODE
+    _PALLAS_MODE = mode
+
+
+def get_pallas_mode() -> str:
+    return _PALLAS_MODE
+
+
+def resolve_pallas() -> Tuple[bool, bool]:
+    """(use_pallas, interpret) for the ambient backend, at trace time."""
+    mode = _PALLAS_MODE
+    if mode == "on":
+        return True, False
+    if mode == "interpret":
+        return True, True
+    if mode == "off":
+        return False, False
+    import jax
+
+    return jax.default_backend() == "tpu", False
+
+
+def transport_solve(
+    wS, supply, col_cap, eps_init, *, alpha: int = 8, max_supersteps: int = 20_000
+):
+    """The layered-transport solve behind the mode switch: the fused
+    Pallas kernel or the XLA phase loop, one call site for both.
+    Returns (y, steps, converged); traceable inside jit/scan."""
+    use_pallas, interpret = resolve_pallas()
+    if use_pallas:
+        from .transport_pallas import transport_loop_pallas
+
+        return transport_loop_pallas(
+            wS, supply, col_cap, eps_init,
+            alpha=alpha, max_supersteps=max_supersteps, interpret=interpret,
+        )
+    from ..solver.layered import _solve_transport
+
+    return _solve_transport(
+        wS, supply, col_cap, eps_init, alpha=alpha, max_supersteps=max_supersteps
+    )
+
+
+def __getattr__(name):
+    if name == "transport_loop_pallas":
+        from .transport_pallas import transport_loop_pallas
+
+        return transport_loop_pallas
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# transport_loop_pallas is intentionally NOT in __all__: a star import
+# would trigger the lazy Pallas TPU import that XLA-only deployments
+# must never take. Access it explicitly (module __getattr__).
+__all__ = [
+    "transport_solve",
+    "set_pallas_mode",
+    "get_pallas_mode",
+    "resolve_pallas",
+]

@@ -14,10 +14,10 @@ timer stops (the reference times ScheduleAllJobs and pushes Bindings to
 the API server after the timed region — cmd/k8sscheduler/scheduler.go:
 146-187).
 
-The solve is the dense layered transport kernel (solver/layered.py)
-under a fixed trip count (lax.fori_loop; the superstep is a fixed point
-after convergence, and each round reports a `converged` flag that
-callers assert on fetch). The decode is fully vectorized and gather-free:
+The solve is the dense layered transport kernel — dispatched via
+ops.transport_solve: the fused Pallas kernel on TPU, the XLA phase loop
+elsewhere; both exit on convergence under a safety bound (`supersteps`),
+and each round reports a `converged` flag that callers assert on fetch. The decode is fully vectorized and gather-free:
 rank-matching placed tasks to machine grants via compare-matrix
 reductions ([Tcap, M] masks) and a tiny [Tcap,M]x[M,P] matmul for the
 within-machine PU split — MXU/VPU work instead of serialized gathers.

@@ -287,8 +287,10 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
     """Bounded transport solve, embeddable in larger jitted programs.
 
     C == 1: the exact closed form (solve_single_class) — O(sort(M)).
-    C >= 2: the cost-scaling phase schedule (_transport_loop), exiting
-    as soon as it converges, bounded by num_supersteps.
+    C >= 2: the cost-scaling phase schedule, exiting as soon as it
+    converges, bounded by num_supersteps — as the fused Pallas kernel
+    (ops/transport_pallas.py, one kernel launch with all state in VMEM)
+    when the ambient backend is TPU, else the XLA `_transport_loop`.
     Returns (y, converged).
     """
     C, Mp1 = wS.shape
@@ -297,10 +299,11 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
         y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
         return y, jnp.bool_(True)
 
-    U = jnp.minimum(supply[:, None], col_cap[None, :])
     eps0 = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
-    y, z, steps, converged = _transport_loop(
-        wS, U, supply, col_cap, eps0, alpha, num_supersteps
+    from ..ops import transport_solve
+
+    y, _steps, converged = transport_solve(
+        wS, supply, col_cap, eps0, alpha=alpha, max_supersteps=num_supersteps
     )
     return y, converged
 
@@ -389,10 +392,12 @@ class LayeredTransportSolver:
                 (np.int32(n_scale), self.max_supersteps),
                 (eps_full, self.max_supersteps),
             ]
+            from ..ops import transport_solve
+
             y = steps = None
             converged = False
             for eps_init, cap_steps in attempts:
-                y, steps, converged = _solve_transport(
+                y, steps, converged = transport_solve(
                     wS_d, sup_d, cap_d, jnp.asarray(eps_init),
                     alpha=self.alpha,
                     max_supersteps=cap_steps,

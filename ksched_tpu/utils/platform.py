@@ -19,3 +19,10 @@ def force_cpu_platform() -> None:
     for plat in list(getattr(xb, "_backend_factories", {})):
         if plat != "cpu":
             xb._backend_factories.pop(plat, None)
+    # Popping the factories also removes "tpu" from xb.known_platforms(),
+    # which would make importing jax.experimental.pallas.tpu blow up when
+    # it registers its TPU lowering rules. Keep the name known via the
+    # alias table — registering lowerings for an uninstantiable platform
+    # is harmless, and the Pallas interpreter path needs the import.
+    if hasattr(xb, "_platform_aliases"):
+        xb._platform_aliases.setdefault("tpu", "tpu")
