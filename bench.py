@@ -48,10 +48,25 @@ def _solver_work(backend) -> int:
     return getattr(backend, "last_supersteps", None) or getattr(backend, "last_iterations", 0)
 
 
-def run_device_bench(args) -> None:
-    """The production path: device-resident cluster, rounds chained on
-    device in `--chunk`-round scans, one block_until_ready per chunk
-    (stats fetches deferred until after all timing — see below).
+def _device_bench(
+    *,
+    tasks: int,
+    machines: int,
+    pus: int,
+    slots: int,
+    jobs: int,
+    churn: float,
+    rounds: int,
+    chunk: int,
+    num_task_classes: int = 1,
+    class_cost_fn=None,
+    supersteps=None,
+    unsched_cost: int = 5,
+    ec_cost: int = 2,
+    label: str = "trivial cost model",
+    verbose: bool = False,
+) -> dict:
+    """Measure sustained p50 round latency on the device-resident path.
 
     The timed region per round matches the reference's (everything
     inside ScheduleAllJobs: stats refresh, graph update, solve, decode,
@@ -74,31 +89,39 @@ def run_device_bench(args) -> None:
 
     rng = np.random.default_rng(0)
     dev = DeviceBulkCluster(
-        num_machines=args.machines,
-        pus_per_machine=args.pus,
-        slots_per_pu=args.slots,
-        num_jobs=args.jobs,
-        num_task_classes=1,
-        task_capacity=next_pow2(args.tasks + 4096),
+        num_machines=machines,
+        pus_per_machine=pus,
+        slots_per_pu=slots,
+        num_jobs=jobs,
+        num_task_classes=num_task_classes,
+        task_capacity=next_pow2(tasks + 4096),
+        class_cost_fn=class_cost_fn,
+        supersteps=supersteps,
+        unsched_cost=unsched_cost,
+        ec_cost=ec_cost,
     )
     devices = jax.devices()
-    churn_n = max(1, int(args.tasks * args.churn))
+    churn_n = max(1, int(tasks * churn))
 
-    dev.add_tasks(args.tasks, rng.integers(0, args.jobs, args.tasks).astype(np.int32))
+    dev.add_tasks(
+        tasks,
+        rng.integers(0, jobs, tasks).astype(np.int32),
+        rng.integers(0, num_task_classes, tasks).astype(np.int32),
+    )
     t0 = time.perf_counter()
     fill = dev.round()
     jax.block_until_ready(fill)
     fill_s = time.perf_counter() - t0
 
-    R = min(args.chunk, args.rounds)
+    R = min(chunk, rounds)
     # warm the scan executable
-    jax.block_until_ready(dev.run_steady_rounds(R, args.churn, churn_n, seed=1))
-    chunks = max(1, -(-args.rounds // R))  # ceil: measure >= requested rounds
+    jax.block_until_ready(dev.run_steady_rounds(R, churn, churn_n, seed=1))
+    chunks = max(1, -(-rounds // R))  # ceil: measure >= requested rounds
     per_round_ms = []
     chunk_stats = []
     for rep in range(chunks):
         t0 = time.perf_counter()
-        stats = dev.run_steady_rounds(R, args.churn, churn_n, seed=2 + rep)
+        stats = dev.run_steady_rounds(R, churn, churn_n, seed=2 + rep)
         jax.block_until_ready(stats)
         per_round_ms.append((time.perf_counter() - t0) / R * 1e3)
         chunk_stats.append(stats)
@@ -106,9 +129,9 @@ def run_device_bench(args) -> None:
     # Clock stopped — now fetch and verify everything.
     fill_got = dev.fetch_stats(fill)
     assert bool(fill_got["converged"]), "fill round did not converge"
-    if args.verbose:
+    if verbose:
         print(
-            f"# fill: placed {int(fill_got['placed'])}/{args.tasks} in "
+            f"# fill: placed {int(fill_got['placed'])}/{tasks} in "
             f"{fill_s:.2f}s (incl compile), "
             f"unsched={int(fill_got['unscheduled'])}",
             file=sys.stderr,
@@ -116,7 +139,7 @@ def run_device_bench(args) -> None:
     for rep, stats in enumerate(chunk_stats):
         got = dev.fetch_stats(stats)
         assert got["converged"].all(), "a steady round did not converge"
-        if args.verbose:
+        if verbose:
             print(
                 f"# chunk {rep}: {per_round_ms[rep]:.3f} ms/round x {R} rounds, "
                 f"placed/round mean {got['placed'].mean():.1f}, "
@@ -126,21 +149,160 @@ def run_device_bench(args) -> None:
 
     p50 = float(np.percentile(per_round_ms, 50))
     target_ms = 10.0
+    return {
+        "metric": (
+            f"p50 scheduling-round latency, {tasks} tasks x "
+            f"{machines} machines, {label}, "
+            f"{churn:.0%} churn, device-resident rounds "
+            f"({R}-round chains), backend=device/{devices[0].platform}"
+        ),
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(target_ms / p50, 3),
+    }
+
+
+def run_device_bench(args) -> None:
     print(
         json.dumps(
-            {
-                "metric": (
-                    f"p50 scheduling-round latency, {args.tasks} tasks x "
-                    f"{args.machines} machines, trivial cost model, "
-                    f"{args.churn:.0%} churn, device-resident rounds "
-                    f"({R}-round chains), backend=device/{devices[0].platform}"
-                ),
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(target_ms / p50, 3),
-            }
+            _device_bench(
+                tasks=args.tasks,
+                machines=args.machines,
+                pus=args.pus,
+                slots=args.slots,
+                jobs=args.jobs,
+                churn=args.churn,
+                rounds=args.rounds,
+                chunk=args.chunk,
+                verbose=args.verbose,
+            )
         )
     )
+
+
+#: the five BASELINE.json benchmark configs (see run_config for each)
+SUITE_CONFIGS = ("ref100", "10kx1k", "coco50k", "whare-hetero", "gtrace12k")
+
+
+def run_config(args) -> None:
+    """One BASELINE.json config, one JSON line.
+
+    ref100       100 tasks x 10 machines, trivial (the reference's
+                 fakeMachines smoke — cmd/k8sscheduler/scheduler.go:191-202).
+    10kx1k       the headline north-star config.
+    coco50k      CoCo interference model, 50k tasks
+                 (coco_interference_scores.proto): 4 task classes,
+                 per-machine penalties, fused-Pallas multi-class solve.
+    whare-hetero Whare-Map (whare_map_stats.proto): per-machine platform
+                 factors modelling a heterogeneous fleet.
+    gtrace12k    Google 2011 cluster-trace replay at 12.5k machines
+                 (task_desc.proto:76-78 trace ids): synthesized trace
+                 streams, elastic membership, incremental re-solves via
+                 the host bulk path.
+    """
+    from ksched_tpu.costmodels.device_costs import (
+        coco_device_cost_fn,
+        whare_device_cost_fn,
+    )
+
+    rng = np.random.default_rng(7)
+    name = args.config
+    if name == "ref100":
+        out = _device_bench(
+            tasks=100, machines=10, pus=1, slots=16, jobs=3,
+            churn=0.05, rounds=128, chunk=64, verbose=args.verbose,
+        )
+    elif name == "10kx1k":
+        out = _device_bench(
+            tasks=10_000, machines=1_000, pus=4, slots=4, jobs=10,
+            churn=0.01, rounds=args.rounds, chunk=args.chunk,
+            verbose=args.verbose,
+        )
+    elif name == "coco50k":
+        from ksched_tpu.costmodels import coco
+
+        penalties = rng.integers(0, 40, (1_000, 4)).astype(np.int64)
+        out = _device_bench(
+            tasks=50_000, machines=1_000, pus=4, slots=16, jobs=20,
+            churn=0.01, rounds=128, chunk=32,
+            num_task_classes=4,
+            class_cost_fn=coco_device_cost_fn(penalties),
+            unsched_cost=coco.UNSCHEDULED_COST,
+            ec_cost=0,
+            supersteps=1 << 17,
+            label="CoCo interference cost model (4 classes)",
+            verbose=args.verbose,
+        )
+    elif name == "whare-hetero":
+        from ksched_tpu.costmodels import whare
+
+        platform_factor = rng.integers(80, 140, 1_000).astype(np.int64)
+        out = _device_bench(
+            tasks=20_000, machines=1_000, pus=4, slots=8, jobs=20,
+            churn=0.01, rounds=128, chunk=32,
+            num_task_classes=4,
+            class_cost_fn=whare_device_cost_fn(
+                slots_per_machine=32, platform_factor=platform_factor
+            ),
+            unsched_cost=whare.UNSCHEDULED_COST,
+            ec_cost=0,
+            supersteps=1 << 17,
+            label="Whare-Map cost model, heterogeneous platforms",
+            verbose=args.verbose,
+        )
+    elif name == "gtrace12k":
+        from ksched_tpu.drivers.trace_replay import TraceReplayDriver, synthesize_trace
+        from ksched_tpu.solver.layered import LayeredTransportSolver
+
+        machines, events = synthesize_trace(
+            num_machines=12_500, num_tasks=60_000, duration_s=600.0, seed=11
+        )
+        driver = TraceReplayDriver(
+            machines, backend=LayeredTransportSolver(), slots_per_machine=8
+        )
+        stats = driver.replay(events, window_s=5.0, max_rounds=60)
+        target_ms = 10.0
+        out = {
+            "metric": (
+                f"p50 scheduling-round latency, Google-trace replay, "
+                f"{driver.num_machines} machines, {stats.rounds} rounds "
+                f"({stats.submitted} submits, {stats.finished} finishes, "
+                f"{stats.evicted} evictions), 4 classes, host bulk path"
+            ),
+            "value": round(stats.p50_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(target_ms / max(stats.p50_ms, 1e-9), 3),
+        }
+    else:
+        raise SystemExit(f"unknown config {name!r}; choose from {SUITE_CONFIGS}")
+    print(json.dumps(out))
+
+
+def run_suite(args) -> None:
+    """All five configs, each in its OWN subprocess: a device-to-host
+    stats fetch permanently degrades later dispatches in the process on
+    the tunneled-TPU transport (see _device_bench), so configs must not
+    share a process or config N's fetches would poison config N+1's
+    measurement."""
+    import subprocess
+
+    for name in SUITE_CONFIGS:
+        cmd = [sys.executable, __file__, "--config", name,
+               "--rounds", str(args.rounds), "--chunk", str(args.chunk)]
+        if args.cpu:
+            cmd.append("--cpu")
+        if args.verbose:
+            cmd.append("--verbose")
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if args.verbose and r.stderr:
+            sys.stderr.write(r.stderr)
+        line = (r.stdout.strip().splitlines() or ["<no output>"])[-1]
+        if r.returncode != 0:
+            print(json.dumps({"metric": f"config {name} FAILED", "value": None,
+                              "unit": "ms", "vs_baseline": 0.0,
+                              "error": (r.stderr or line)[-400:]}))
+        else:
+            print(line)
 
 
 def build(args):
@@ -186,6 +348,17 @@ def main():
         "--chunk", type=int, default=64,
         help="device path: rounds per on-device scan chunk",
     )
+    ap.add_argument(
+        "--suite", action="store_true",
+        help="run all five BASELINE.json configs (prints one JSON line "
+        "per config instead of the single headline line); --rounds/"
+        "--chunk apply only to the 10kx1k config — the others use "
+        "fixed per-config budgets",
+    )
+    ap.add_argument(
+        "--config", choices=SUITE_CONFIGS, default=None,
+        help="run a single named BASELINE.json config",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -202,6 +375,10 @@ def main():
 
     import jax
 
+    if args.suite:
+        return run_suite(args)
+    if args.config:
+        return run_config(args)
     if args.backend in ("auto", "device"):
         args.backend = "device"
         return run_device_bench(args)

@@ -1,0 +1,77 @@
+"""Traceable (jnp) twins of the vectorized cost matrices, for the
+device-resident scheduling round.
+
+DeviceBulkCluster's `class_cost_fn` runs inside the jitted round and
+receives the on-device running-class census [M, C]; these functions turn
+it into the [C, M] arc-cost matrix the transport solve consumes — the
+same policies as the numpy forms (costmodels/coco.py `coco_cost_matrix`,
+costmodels/whare.py `whare_cost_matrix`; tests assert elementwise
+equality), expressed in jnp so the whole round stays one compiled
+program.
+
+The reference plans these models but never implements them
+(costmodel/interface.go:33-43); the policy inputs exist as protos
+(coco_interference_scores.proto:11-16, whare_map_stats.proto:12-18).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .coco import INTERFERENCE, MAX_COST as COCO_MAX_COST
+from .whare import IDLE_BONUS, MAX_COST as WHARE_MAX_COST, PSI_PRIOR
+
+
+def coco_device_cost_fn(penalties: Optional[np.ndarray] = None):
+    """class_cost_fn for CoCo: census [M, 4] -> cost [4, M] int32.
+
+    penalties: optional [M, 4] static per-machine per-incoming-class
+    penalty matrix (CoCoInterferenceScores), closed over as a constant.
+    """
+    W = jnp.asarray(INTERFERENCE, jnp.int32)
+    pen = None if penalties is None else jnp.asarray(penalties.T, jnp.int32)
+
+    def fn(census):
+        cost = W @ census.T.astype(jnp.int32)  # [4, M]
+        if pen is not None:
+            cost = cost + pen
+        return jnp.minimum(cost, COCO_MAX_COST).astype(jnp.int32)
+
+    return fn
+
+
+def whare_device_cost_fn(
+    slots_per_machine: int,
+    psi: Optional[np.ndarray] = None,
+    platform_factor: Optional[np.ndarray] = None,
+):
+    """class_cost_fn for Whare-Map: census [M, 4] -> cost [4, M] int32.
+
+    slots_per_machine: total slots per machine (homogeneous topology, so
+    idle(m) = slots - census row sum — the device round has no separate
+    idle input).
+    psi: optional [4, 4] slowdown map (default: the learning prior).
+    platform_factor: optional [M] percentage multiplier (100 = neutral)
+    modelling heterogeneous machine platforms (the "heterogeneity in
+    homogeneous WSCs" axis of Whare-Map); applied to the expected
+    slowdown before the idle bonus.
+    """
+    psi_d = jnp.asarray(PSI_PRIOR if psi is None else psi, jnp.int32)
+    plat = None if platform_factor is None else jnp.asarray(platform_factor, jnp.int32)
+    slots = int(slots_per_machine)
+
+    def fn(census):
+        c32 = census.astype(jnp.int32)
+        tot = jnp.maximum(1, jnp.sum(c32, axis=1))  # [M]
+        expected = (psi_d @ c32.T) // tot[None, :]  # [4, M]
+        if plat is not None:
+            expected = (expected * plat[None, :]) // 100
+        idle = jnp.maximum(0, slots - jnp.sum(c32, axis=1))
+        bonus = (IDLE_BONUS * idle) // slots
+        cost = expected - bonus[None, :]
+        return jnp.clip(cost, 0, WHARE_MAX_COST).astype(jnp.int32)
+
+    return fn

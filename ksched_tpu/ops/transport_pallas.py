@@ -44,23 +44,13 @@ _BIG = 1 << 30
 _BIG_D = 1 << 28
 
 
-def _cumsum_lanes(x, n: int):
-    """Inclusive prefix sum along axis=1 (lanes): Hillis–Steele."""
-    idx = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+def _cumsum(x, axis: int, n: int):
+    """Inclusive prefix sum along `axis` (length n): Hillis–Steele —
+    log-step rolls with iota masks, since cumsum doesn't lower."""
+    idx = lax.broadcasted_iota(jnp.int32, x.shape, axis)
     k = 1
     while k < n:
-        shifted = pltpu.roll(x, shift=k, axis=1)
-        x = x + jnp.where(idx >= k, shifted, 0)
-        k <<= 1
-    return x
-
-
-def _cumsum_rows(x, n: int):
-    """Inclusive prefix sum along axis=0 (sublanes): Hillis–Steele."""
-    idx = lax.broadcasted_iota(jnp.int32, x.shape, 0)
-    k = 1
-    while k < n:
-        shifted = pltpu.roll(x, shift=k, axis=0)
+        shifted = pltpu.roll(x, shift=k, axis=axis)
         x = x + jnp.where(idx >= k, shifted, 0)
         k <<= 1
     return x
@@ -107,7 +97,7 @@ def _transport_kernel(
         # in-row exclusive prefix sums)
         r_fwd = U - y
         r_adm = jnp.where((r_fwd > 0) & (rcf < 0), r_fwd, i32(0))
-        excl = _cumsum_lanes(r_adm, Mp) - r_adm
+        excl = _cumsum(r_adm, 1, Mp) - r_adm
         delta_f = jnp.clip(e_row - excl, 0, r_adm)
 
         # columns push: sink entry first, then backward col->row entries
@@ -115,13 +105,13 @@ def _transport_kernel(
         adm_s = jnp.where((r_s > 0) & (pm - psink < 0), r_s, i32(0))   # [1, Mp]
         rc_b = pm - pr - wS
         adm_b = jnp.where((y > 0) & (rc_b < 0), y, i32(0))             # [C, Mp]
-        excl_b = adm_s + (_cumsum_rows(adm_b, C) - adm_b)
+        excl_b = adm_s + (_cumsum(adm_b, 0, C) - adm_b)
         delta_s = jnp.clip(e_col, 0, adm_s)
         delta_b = jnp.clip(e_col - excl_b, 0, adm_b)
 
         # sink pushes back along backward sink->col arcs
         zb_adm = jnp.where((z > 0) & (psink - pm < 0), z, i32(0))      # [1, Mp]
-        excl_zb = _cumsum_lanes(zb_adm, Mp) - zb_adm
+        excl_zb = _cumsum(zb_adm, 1, Mp) - zb_adm
         delta_zb = jnp.clip(e_sink - excl_zb, 0, zb_adm)
 
         y2 = y + delta_f - delta_b

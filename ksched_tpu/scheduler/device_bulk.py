@@ -152,7 +152,13 @@ class DeviceBulkCluster:
             col_cap = (
                 jnp.zeros(Mp, i32).at[:M].set(machine_free).at[Mp - 1].set(total)
             )
-            y, converged = transport_fori(wS, supply, col_cap, supersteps)
+            # With no class cost model the cost matrix is statically
+            # uniform across classes — the degenerate collapse avoids
+            # the iterative solve entirely (closed form + class split).
+            y, converged = transport_fori(
+                wS, supply, col_cap, supersteps, eps0=n_scale,
+                class_degenerate=cost_fn is None,
+            )
             y_real = y[:, :M]
 
             # ---- decode: rank-match placed tasks to machine grants ----

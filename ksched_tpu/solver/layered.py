@@ -229,6 +229,22 @@ def solve_single_class_np(w: np.ndarray, supply: int, col_cap: np.ndarray) -> np
     return y
 
 
+def split_grants_by_class(y_tot, supply):
+    """Split single-class machine grants y_tot[Mp] among C classes with
+    per-class supplies [C] — any split is cost-equal when every class
+    has the same cost row (the class-degenerate case), so grant units
+    are handed out in (machine-order, class-order): y[c,m] = overlap of
+    the class's supply interval with the machine's grant interval.
+    Works on numpy or jnp arrays (pure elementwise/broadcast math)."""
+    xp = np if isinstance(y_tot, np.ndarray) else jnp
+    cum_s = xp.cumsum(supply)
+    excl_s = (cum_s - supply)[:, None]  # [C, 1] class interval starts
+    cum_m = xp.cumsum(y_tot)[None, :]  # [1, Mp] machine interval ends
+    lo = xp.maximum(cum_m - y_tot[None, :], excl_s)
+    hi = xp.minimum(cum_m, excl_s + supply[:, None])
+    return xp.maximum(hi - lo, 0).astype(y_tot.dtype)
+
+
 def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps):
     """The cost-scaling phase schedule as a bounded lax.while_loop:
     each iteration either runs a superstep (while active nodes exist)
@@ -283,7 +299,8 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps):
     return y, z, steps, done & (max_abs == 0)
 
 
-def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
+def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
+                   eps0: Optional[int] = None, class_degenerate: bool = False):
     """Bounded transport solve, embeddable in larger jitted programs.
 
     C == 1: the exact closed form (solve_single_class) — O(sort(M)).
@@ -291,6 +308,20 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
     converges, bounded by num_supersteps — as the fused Pallas kernel
     (ops/transport_pallas.py, one kernel launch with all state in VMEM)
     when the ambient backend is TPU, else the XLA `_transport_loop`.
+
+    eps0: optional static eps-schedule start. Passing the problem's
+    n_scale (one original cost unit) cuts supersteps ~20x on contended
+    instances — valid for any start since tightened potentials make the
+    zero flow 0-optimal; if the short schedule stalls within the budget,
+    an in-graph lax.cond falls back to the full range, so convergence
+    never regresses.
+
+    class_degenerate: static flag asserting every class has the SAME
+    cost row (e.g. no class cost model wired in). Classes are then
+    interchangeable and the iterative multi-class solve — which herds
+    badly on identical costs (all classes chase the same columns in
+    lockstep) — collapses to the exact C=1 closed form plus an
+    arbitrary-but-feasible split of grants among classes.
     Returns (y, converged).
     """
     C, Mp1 = wS.shape
@@ -298,14 +329,33 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8):
     if C == 1:
         y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
         return y, jnp.bool_(True)
+    if class_degenerate:
+        y_tot = solve_single_class(wS[0], jnp.sum(supply), col_cap)
+        return split_grants_by_class(y_tot, supply), jnp.bool_(True)
 
-    eps0 = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
+    eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
     from ..ops import transport_solve
 
-    y, _steps, converged = transport_solve(
-        wS, supply, col_cap, eps0, alpha=alpha, max_supersteps=num_supersteps
+    if eps0 is None:
+        y, _steps, converged = transport_solve(
+            wS, supply, col_cap, eps_full, alpha=alpha, max_supersteps=num_supersteps
+        )
+        return y, converged
+
+    y1, _s1, conv1 = transport_solve(
+        wS, supply, col_cap, i32(eps0), alpha=alpha, max_supersteps=num_supersteps
     )
-    return y, converged
+
+    def keep(_):
+        return y1, conv1
+
+    def retry(_):
+        y2, _s2, conv2 = transport_solve(
+            wS, supply, col_cap, eps_full, alpha=alpha, max_supersteps=num_supersteps
+        )
+        return y2, conv2
+
+    return lax.cond(conv1, keep, retry, operand=None)
 
 
 @functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
@@ -376,6 +426,14 @@ class LayeredTransportSolver:
             # Exact closed form, pure host numpy: sort + greedy fill of
             # strictly-profitable capacity (see solve_single_class).
             y_np = solve_single_class_np(wP[0], total, col_cap)[None, :]
+            self.last_supersteps = 0
+        elif (wP == wP[0]).all():
+            # Class-degenerate (all cost rows equal): exact closed form
+            # on the total supply, grants split arbitrarily by class —
+            # the iterative solve herds pathologically on identical
+            # costs, and no split can beat another.
+            y_tot = solve_single_class_np(wP[0], total, col_cap)
+            y_np = split_grants_by_class(y_tot, supply)
             self.last_supersteps = 0
         else:
             # Multi-class: cost-scaling push-relabel on device. Start the

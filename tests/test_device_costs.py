@@ -1,0 +1,54 @@
+"""The traceable device cost matrices (costmodels/device_costs.py) must
+agree elementwise with the numpy policy implementations the host path
+uses (coco_cost_matrix / whare_cost_matrix)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ksched_tpu.costmodels.coco import coco_cost_matrix
+from ksched_tpu.costmodels.device_costs import coco_device_cost_fn, whare_device_cost_fn
+from ksched_tpu.costmodels.whare import whare_cost_matrix
+
+
+def test_coco_device_matches_numpy():
+    rng = np.random.default_rng(0)
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        M = int(rng.integers(3, 50))
+        census = rng.integers(0, 10, (M, 4)).astype(np.int64)
+        penalties = rng.integers(0, 50, (M, 4)).astype(np.int64)
+        want = coco_cost_matrix(census, penalties)
+        got = np.asarray(coco_device_cost_fn(penalties)(jnp.asarray(census)))
+        np.testing.assert_array_equal(got, want)
+        # and the no-penalty form
+        want0 = coco_cost_matrix(census)
+        got0 = np.asarray(coco_device_cost_fn()(jnp.asarray(census)))
+        np.testing.assert_array_equal(got0, want0)
+
+
+def test_whare_device_matches_numpy_homogeneous():
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        M = int(rng.integers(3, 50))
+        slots = 16
+        census = rng.integers(0, 5, (M, 4)).astype(np.int64)
+        census = np.minimum(census, slots)  # can't run more than slots
+        idle = np.maximum(0, slots - census.sum(axis=1))
+        want = whare_cost_matrix(census, idle, np.full(M, slots, np.int64))
+        got = np.asarray(
+            whare_device_cost_fn(slots_per_machine=slots)(jnp.asarray(census))
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_whare_platform_factor_scales_expected_slowdown():
+    """Heterogeneity: a slower platform (factor > 100) must never be
+    cheaper than a faster one with the same census."""
+    census = np.full((2, 4), 2, np.int64)
+    fast_slow = np.asarray([90, 130], np.int64)
+    cost = np.asarray(
+        whare_device_cost_fn(slots_per_machine=16, platform_factor=fast_slow)(
+            jnp.asarray(census)
+        )
+    )
+    assert (cost[:, 1] >= cost[:, 0]).all()
