@@ -1,8 +1,18 @@
 from .sharded_solver import ShardedJaxSolver, ShardedPlan, build_sharded_plan, make_sharded_solver
+from .whatif import (
+    ScenarioBatchResult,
+    WhatIfSolver,
+    drain_scenarios,
+    surge_scenarios,
+)
 
 __all__ = [
     "ShardedJaxSolver",
     "ShardedPlan",
     "build_sharded_plan",
     "make_sharded_solver",
+    "ScenarioBatchResult",
+    "WhatIfSolver",
+    "drain_scenarios",
+    "surge_scenarios",
 ]

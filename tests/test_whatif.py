@@ -1,0 +1,105 @@
+"""Batched what-if solves (parallel/whatif.py): a K-scenario batch must
+agree with K sequential solves, and the drain/surge builders must model
+their scenarios faithfully against a live cluster."""
+
+import numpy as np
+import pytest
+
+from ksched_tpu.parallel.whatif import WhatIfSolver, drain_scenarios, surge_scenarios
+from ksched_tpu.scheduler.bulk import BulkCluster
+from ksched_tpu.solver.layered import LayeredProblem, LayeredTransportSolver
+
+
+@pytest.mark.parametrize("C", [1, 3])
+def test_batch_matches_sequential(C):
+    rng = np.random.default_rng(0)
+    M, K = 20, 6
+    solver = WhatIfSolver(M, C, unsched_cost=25, ec_cost=2)
+    seq = LayeredTransportSolver()
+    cost_cm = rng.integers(0, 15, (K, C, M)).astype(np.int64)
+    supply = rng.integers(0, 50, (K, C)).astype(np.int64)
+    col_cap = rng.integers(0, 8, (K, M)).astype(np.int64)
+
+    batch = solver.solve_batch(cost_cm, supply, col_cap)
+    assert batch.converged.all()
+    for k in range(K):
+        res = seq.solve_layered(
+            LayeredProblem(
+                supply=supply[k].astype(np.int32),
+                col_cap=col_cap[k].astype(np.int32),
+                cost_cm=cost_cm[k].astype(np.int32),
+                unsched_cost=25,
+                ec_cost=2,
+            )
+        )
+        assert batch.objective[k] == res.objective, f"scenario {k}"
+        assert batch.num_unsched[k] == res.num_unsched
+
+
+def _cluster(C=2, M=6, seed=3):
+    rng = np.random.default_rng(seed)
+    cost = rng.integers(0, 10, (C, M)).astype(np.int32)
+    cluster = BulkCluster(
+        num_machines=M,
+        pus_per_machine=2,
+        slots_per_pu=2,
+        num_jobs=3,
+        backend=LayeredTransportSolver(),
+        task_capacity=256,
+        num_task_classes=C,
+        class_cost_fn=lambda cl: cost,
+        unsched_cost=25,
+    )
+    n = 20
+    cluster.add_tasks(
+        n, rng.integers(0, 3, n).astype(np.int32), rng.integers(0, C, n).astype(np.int32)
+    )
+    cluster.round()
+    return cluster
+
+
+def test_drain_scenarios_cover_displaced_tasks():
+    cluster = _cluster()
+    res = drain_scenarios(cluster, np.arange(cluster.M))
+    assert res.converged.all()
+    # each scenario k: capacity of machine k gone, so nothing lands there
+    for k in range(cluster.M):
+        assert res.y[k, :, k].sum() == 0
+    # scenario supply included the displaced tasks: placements+unsched
+    # must account for backlog + displaced of that machine
+    placed_machine = np.where(
+        cluster.task_live & (cluster.task_pu >= 0), cluster.task_pu // cluster.P, -1
+    )
+    backlog = int((cluster.task_live & (cluster.task_pu < 0)).sum())
+    for k in range(cluster.M):
+        displaced = int((placed_machine == k).sum())
+        assert res.y[k].sum() + res.num_unsched[k] == backlog + displaced
+
+
+def test_degenerate_batch_and_index_guard():
+    """Uniform cost rows take the closed-form collapse (stock
+    no-cost-model config), and negative drain indices raise instead of
+    aliasing the unplaced sentinel."""
+    s = WhatIfSolver(8, 3, unsched_cost=25, ec_cost=2)
+    cost = np.zeros((3, 8), np.int64)
+    res = s.solve_batch(
+        cost, np.full((4, 3), 7, np.int64), np.full((4, 8), 2, np.int64)
+    )
+    assert res.converged.all()
+    assert (res.num_unsched == 5).all()  # 21 supply into 16 slots
+
+    cluster = _cluster()
+    with pytest.raises(IndexError):
+        drain_scenarios(cluster, [-1])
+    with pytest.raises(IndexError):
+        drain_scenarios(cluster, [cluster.M])
+
+
+def test_surge_scenarios_monotone_unsched():
+    """More surge can never mean fewer unscheduled tasks."""
+    cluster = _cluster()
+    C = cluster.C
+    surges = np.stack([np.full(C, s) for s in (0, 5, 50, 500)])
+    res = surge_scenarios(cluster, surges)
+    assert res.converged.all()
+    assert (np.diff(res.num_unsched) >= 0).all()
