@@ -91,6 +91,14 @@ class FlowScheduler:
 
         self.resource_roots: Set[int] = set()  # ids of registered topology roots
         self._root_rtnds: Dict[int, ResourceTopologyNodeDescriptor] = {}
+        # The coordinator root registered above IS a topology root: the
+        # per-iteration UpdateResourceTopology pass (reference
+        # flowscheduler/scheduler.go:371-375) walks the roots to refresh
+        # num_slots_below/num_running_tasks_below — without this entry
+        # the refresh walks nothing and running-task stats never update.
+        root_rid = resource_id_from_string(root.resource_desc.uuid)
+        self.resource_roots.add(root_rid)
+        self._root_rtnds[root_rid] = root
         self.task_bindings: Dict[int, int] = {}
         self.resource_bindings: Dict[int, Set[int]] = {}
         self.jobs_to_schedule: Dict[int, JobDescriptor] = {}
