@@ -53,23 +53,26 @@ def resolve_pallas() -> Tuple[bool, bool]:
 
 
 def transport_solve(
-    wS, supply, col_cap, eps_init, *, alpha: int = 8, max_supersteps: int = 20_000
+    wS, supply, col_cap, eps_init, pm0=None, *,
+    alpha: int = 8, max_supersteps: int = 20_000,
 ):
     """The layered-transport solve behind the mode switch: the fused
     Pallas kernel or the XLA phase loop, one call site for both.
-    Returns (y, steps, converged); traceable inside jit/scan."""
+    pm0 optionally warm-starts machine prices (carried across rounds).
+    Returns (y, pm, steps, converged); traceable inside jit/scan."""
     use_pallas, interpret = resolve_pallas()
     if use_pallas:
         from .transport_pallas import transport_loop_pallas
 
         return transport_loop_pallas(
-            wS, supply, col_cap, eps_init,
+            wS, supply, col_cap, eps_init, pm0,
             alpha=alpha, max_supersteps=max_supersteps, interpret=interpret,
         )
     from ..solver.layered import _solve_transport
 
     return _solve_transport(
-        wS, supply, col_cap, eps_init, alpha=alpha, max_supersteps=max_supersteps
+        wS, supply, col_cap, eps_init, pm0,
+        alpha=alpha, max_supersteps=max_supersteps,
     )
 
 

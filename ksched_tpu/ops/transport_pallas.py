@@ -57,8 +57,8 @@ def _cumsum(x, axis: int, n: int):
 
 
 def _transport_kernel(
-    wS_ref, supply_ref, colcap_ref, eps_ref,
-    y_ref, steps_ref, conv_ref,
+    wS_ref, supply_ref, colcap_ref, eps_ref, pminit_ref,
+    y_ref, pm_ref, steps_ref, conv_ref,
     *, C: int, Mp: int, alpha: int, max_supersteps: int,
 ):
     i32 = jnp.int32
@@ -66,6 +66,7 @@ def _transport_kernel(
     supply = supply_ref[:]               # [C, 1]
     col_cap = colcap_ref[:]              # [1, Mp]
     eps0 = eps_ref[0]
+    pm_init = pminit_ref[:]              # [1, Mp] carried machine prices
     U = jnp.minimum(supply, col_cap)     # [C, Mp] fwd arc capacity
 
     def excesses(y, z):
@@ -74,13 +75,17 @@ def _transport_kernel(
         e_sink = jnp.sum(z) - jnp.sum(supply)                     # scalar
         return e_row, e_col, e_sink
 
-    # --- price tightening: exact shortest distances for the zero flow
-    # (the all-forward residual graph has diameter 2) ---
-    d_col = jnp.where(col_cap > 0, i32(0), _BIG_D)                # [1, Mp]
-    d_row = jnp.min(jnp.where(U > 0, wS + d_col, _BIG_D), axis=1, keepdims=True)
-    pr0 = -jnp.minimum(d_row, _BIG_D)                             # [C, 1]
-    pm0 = -jnp.minimum(d_col, _BIG_D)                             # [1, Mp]
-    psink0 = jnp.zeros((1, 1), i32)
+    # --- price tightening from the carried machine prices: re-derive
+    # row/sink potentials so the zero flow is 0-optimal for ANY pm_init
+    # (zeros reduce exactly to cold shortest-distance tightening; see
+    # solver/layered.py transport_tighten) ---
+    live = col_cap > 0
+    pm0 = jnp.where(live, pm_init, -_BIG_D)                       # [1, Mp]
+    has_arc = U > 0
+    pr0 = jnp.max(jnp.where(has_arc, pm0 - wS, -_BIG_D), axis=1, keepdims=True)
+    pr0 = jnp.where(jnp.any(has_arc, axis=1, keepdims=True), pr0, i32(0))
+    psink0 = jnp.min(jnp.where(live, pm0, _BIG_D)).reshape(1, 1)
+    psink0 = jnp.where(jnp.any(live), psink0, i32(0))
 
     def saturate(y, z, pr, pm, psink):
         rcf = wS + pr - pm
@@ -176,6 +181,7 @@ def _transport_kernel(
         jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink)),
     )
     y_ref[:] = y
+    pm_ref[:] = pm
     steps_ref[0] = steps
     conv_ref[0] = (done & (max_abs == 0)).astype(i32)
 
@@ -184,25 +190,30 @@ def _transport_kernel(
     jax.jit, static_argnames=("alpha", "max_supersteps", "interpret")
 )
 def transport_loop_pallas(
-    wS, supply, col_cap, eps_init,
+    wS, supply, col_cap, eps_init, pm0=None,
     alpha: int = 8,
     max_supersteps: int = 20_000,
     interpret: bool = False,
 ):
     """Drop-in twin of solver/layered.py `_transport_loop`'s public
-    result (y, steps, converged), one fused kernel per solve.
+    result (y, pm, steps, converged), one fused kernel per solve.
 
     wS: int32[C, Mp] scaled costs; supply: int32[C]; col_cap: int32[Mp];
-    eps_init: int32 scalar. `interpret=True` runs the kernel under the
-    Pallas interpreter (for CPU-only test environments)."""
+    eps_init: int32 scalar; pm0: optional int32[Mp] carried machine
+    prices (warm start — any value valid, zeros = cold). `interpret=True`
+    runs the kernel under the Pallas interpreter (for CPU-only test
+    environments)."""
     C, Mp = wS.shape
-    y, steps, conv = pl.pallas_call(
+    if pm0 is None:
+        pm0 = jnp.zeros((Mp,), jnp.int32)
+    y, pm, steps, conv = pl.pallas_call(
         functools.partial(
             _transport_kernel,
             C=C, Mp=Mp, alpha=alpha, max_supersteps=max_supersteps,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((C, Mp), jnp.int32),
+            jax.ShapeDtypeStruct((1, Mp), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
@@ -211,8 +222,10 @@ def transport_loop_pallas(
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -223,5 +236,6 @@ def transport_loop_pallas(
         supply.astype(jnp.int32).reshape(C, 1),
         col_cap.astype(jnp.int32).reshape(1, Mp),
         eps_init.astype(jnp.int32).reshape(1),
+        pm0.astype(jnp.int32).reshape(1, Mp),
     )
-    return y, steps[0], conv[0] != 0
+    return y, pm.reshape(Mp), steps[0], conv[0] != 0

@@ -64,10 +64,11 @@ def _batch_solve(wS, supply, col_cap, n_scale, alpha, max_supersteps,
 
     def one(args):
         w, s, cap = args
-        return transport_fori(
+        y, _pm, conv = transport_fori(
             w, s, cap, max_supersteps, alpha=alpha, eps0=n_scale,
             class_degenerate=class_degenerate,
         )
+        return y, conv
 
     return jax.lax.map(one, (wS, supply, col_cap))
 

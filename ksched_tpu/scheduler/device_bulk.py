@@ -155,13 +155,32 @@ class DeviceBulkCluster:
             # With no class cost model the cost matrix is statically
             # uniform across classes — the degenerate collapse avoids
             # the iterative solve entirely (closed form + class split).
-            y, converged = transport_fori(
-                wS, supply, col_cap, supersteps, eps0=n_scale,
+            # Deliberately COLD-started every round (pm0=None): carrying
+            # the previous round's near-optimal machine prices flattens
+            # reduced costs to ~0 across thousands of machines, which
+            # destroys the cost discrimination the synchronous maximal
+            # push relies on and recreates the identical-cost herding
+            # pathology — measured 20x SLOWER (9ms -> 197ms/round on the
+            # CoCo 50k config) than cold tightening, which re-derives
+            # prices from the cost structure each round.
+            # eps0 = n_scale/16: measured ~5x fewer supersteps than
+            # starting at one original cost unit on contended
+            # interference-model instances, still exactly optimal (any
+            # eps0 is valid off tightened potentials; the in-graph
+            # fallback to the full schedule covers pathologies).
+            y, _pm, converged = transport_fori(
+                wS, supply, col_cap, supersteps,
+                eps0=max(1, n_scale // 16),
                 class_degenerate=cost_fn is None,
             )
             y_real = y[:, :M]
 
             # ---- decode: rank-match placed tasks to machine grants ----
+            # One class-gathered pass instead of a per-class loop: each
+            # class's cumulative-grant row is gathered per task via a
+            # one-hot [Tcap, C] x [C, M] matmul (MXU; counts < 2^24 so
+            # f32 accumulation is exact), cutting the number of
+            # [Tcap, M]-sized VPU passes from ~12*C to ~5.
             t_m = jnp.sum(y_real, axis=0)
             pf2 = pu_free.reshape(M, P)
             exclg = jnp.cumsum(pf2, axis=1) - pf2
@@ -170,28 +189,43 @@ class DeviceBulkCluster:
             # exclusive per-class offsets into each machine's grant slots
             offs = jnp.cumsum(y_real, axis=0) - y_real  # [C, M]
 
-            new_pu = state.pu
-            placed_any = jnp.zeros(Tcap, jnp.bool_)
             cols = jnp.arange(M, dtype=i32)[None, :]
+            # per-class ranks among unplaced rows ([Tcap]-sized, cheap);
+            # classes partition tasks, so a masked sum merges them
+            rank = jnp.zeros(Tcap, i32)
+            placed_any = jnp.zeros(Tcap, jnp.bool_)
             for c in range(C):
                 mask_c = unplaced & (state.cls == c)
-                rank = jnp.cumsum(mask_c.astype(i32)) - 1  # [Tcap]
-                p_c = jnp.sum(y_real[c])
-                place_c = mask_c & (rank < p_c)
-                cum = jnp.cumsum(y_real[c])  # [M] inclusive
-                cmp = cum[None, :] <= rank[:, None]  # [Tcap, M]
-                machine = jnp.sum(cmp, axis=1, dtype=i32)  # grant machine
-                excl_at = jnp.max(jnp.where(cmp, cum[None, :], 0), axis=1)
-                oh = machine[:, None] == cols  # [Tcap, M]
-                off_at = jnp.sum(jnp.where(oh, offs[c][None, :], 0), axis=1)
-                slot = off_at + (rank - excl_at)  # within-machine slot
-                cg_at = jnp.einsum(
-                    "tm,mp->tp", oh.astype(jnp.float32), cumg
-                )  # [Tcap, P]; counts < 2^24, exact in f32
-                pu_in = jnp.sum(cg_at <= slot[:, None].astype(jnp.float32), axis=1)
-                pu_abs = machine * P + pu_in.astype(i32)
-                new_pu = jnp.where(place_c, pu_abs, new_pu)
-                placed_any = placed_any | place_c
+                r = jnp.cumsum(mask_c.astype(i32)) - 1
+                rank = jnp.where(mask_c, r, rank)
+                placed_any = placed_any | (mask_c & (r < jnp.sum(y_real[c])))
+
+            onehot = (
+                (state.cls[:, None] == jnp.arange(C, dtype=i32)[None, :])
+                & unplaced[:, None]
+            ).astype(jnp.float32)  # [Tcap, C]
+            # precision=HIGHEST: TPU f32 matmuls default to bf16 passes,
+            # whose 8-bit mantissa corrupts counts beyond 256 — these
+            # gathers carry cumulative grant counts up to Tcap.
+            hi = jax.lax.Precision.HIGHEST
+            cum_all = jnp.cumsum(y_real, axis=1).astype(jnp.float32)  # [C, M]
+            cum_sel = jnp.einsum("tc,cm->tm", onehot, cum_all, precision=hi)
+            off_sel = jnp.einsum(
+                "tc,cm->tm", onehot, offs.astype(jnp.float32), precision=hi
+            )
+            rank_f = rank.astype(jnp.float32)
+            cmp = cum_sel <= rank_f[:, None]  # [Tcap, M]
+            machine = jnp.sum(cmp, axis=1, dtype=i32)  # grant machine
+            excl_at = jnp.max(jnp.where(cmp, cum_sel, 0.0), axis=1)
+            oh = machine[:, None] == cols  # [Tcap, M]
+            off_at = jnp.sum(jnp.where(oh, off_sel, 0.0), axis=1)
+            slot = off_at + (rank_f - excl_at)  # within-machine slot
+            cg_at = jnp.einsum(
+                "tm,mp->tp", oh.astype(jnp.float32), cumg, precision=hi
+            )  # [Tcap, P]; counts < 2^24, exact in f32 at HIGHEST
+            pu_in = jnp.sum(cg_at <= slot[:, None], axis=1)
+            pu_abs = machine * P + pu_in.astype(i32)
+            new_pu = jnp.where(placed_any, pu_abs, state.pu)
 
             idx = jnp.where(placed_any, new_pu, num_pus)
             pu_running = (

@@ -106,16 +106,30 @@ def _excesses(supply, y, z):
     return e_row, e_col, e_sink
 
 
-def transport_tighten(wS, U, col_cap):
-    """Shortest residual-cost distance to the sink for the zero flow
-    (all-forward residual graph, diameter 2 — exact in 2 sweeps).
-    Returns potentials (pr, pm, psink) = -d."""
+def transport_tighten(wS, U, col_cap, pm0=None):
+    """Potentials making the ZERO flow 0-optimal, from optional carried
+    machine prices pm0 (warm start across rounds).
+
+    pm = pm0 on live columns (cap>0), sunk for dead ones; row prices are
+    re-derived as pr[c] = max_{U>0}(pm - wS) so every forward residual
+    arc has reduced cost >= 0, and psink = min_{cap>0} pm likewise. Any
+    pm0 is VALID (optimality of the start point is re-established by
+    construction) — a good pm0 just makes the discharge shorter. With
+    pm0 = None/zeros this reduces exactly to shortest residual-cost
+    distances for the zero flow (the all-forward residual graph has
+    diameter 2), i.e. the cold start."""
     i32 = jnp.int32
-    d_col = jnp.where(col_cap > 0, i32(0), jnp.int32(_BIG_D))
+    big_d = jnp.int32(_BIG_D)
+    if pm0 is None:
+        pm0 = jnp.zeros_like(col_cap)
+    live = col_cap > 0
+    pm = jnp.where(live, pm0, -big_d)
     has_arc = U > 0
-    d_row = jnp.min(jnp.where(has_arc, wS + d_col[None, :], jnp.int32(_BIG_D)), axis=1)
-    d_row = jnp.minimum(d_row, jnp.int32(_BIG_D))
-    return -d_row, -jnp.minimum(d_col, jnp.int32(_BIG_D)), i32(0)
+    pr = jnp.max(jnp.where(has_arc, pm[None, :] - wS, -big_d), axis=1)
+    pr = jnp.where(jnp.any(has_arc, axis=1), pr, i32(0))
+    psink = jnp.min(jnp.where(live, pm, big_d))
+    psink = jnp.where(jnp.any(live), psink, i32(0))
+    return pr, pm, psink
 
 
 def transport_saturate(wS, U, col_cap, y, z, pr, pm, psink):
@@ -245,13 +259,17 @@ def split_grants_by_class(y_tot, supply):
     return xp.maximum(hi - lo, 0).astype(y_tot.dtype)
 
 
-def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps):
+def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
+                    pm_init=None):
     """The cost-scaling phase schedule as a bounded lax.while_loop:
     each iteration either runs a superstep (while active nodes exist)
     or advances the eps phase; exits as soon as the eps=1 phase drains
     (early exit matters — a converged multi-class solve typically takes
     tens of supersteps against a bound of thousands). Legal inside jit
-    and inside lax.scan bodies. Returns (y, z, steps, converged)."""
+    and inside lax.scan bodies. pm_init optionally warm-starts the
+    machine prices (see transport_tighten). Returns
+    (y, z, pm, steps, converged) — pm is the final machine-price vector,
+    for carrying into the next round."""
     i32 = jnp.int32
 
     def phase_cond(state):
@@ -285,7 +303,7 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps):
         return lax.cond(any_active, do_step, next_phase, operand=None)
 
     C, Mp1 = wS.shape
-    pr0, pm0, psink0 = transport_tighten(wS, U, col_cap)
+    pr0, pm0, psink0 = transport_tighten(wS, U, col_cap, pm_init)
     y0 = jnp.zeros((C, Mp1), i32)
     z0 = jnp.zeros((Mp1,), i32)
     state = (y0, z0, pr0, pm0, psink0, eps_init, i32(0), jnp.bool_(False))
@@ -296,11 +314,12 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps):
     max_abs = jnp.maximum(
         jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
     )
-    return y, z, steps, done & (max_abs == 0)
+    return y, z, pm, steps, done & (max_abs == 0)
 
 
 def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
-                   eps0: Optional[int] = None, class_degenerate: bool = False):
+                   eps0: Optional[int] = None, class_degenerate: bool = False,
+                   pm0=None):
     """Bounded transport solve, embeddable in larger jitted programs.
 
     C == 1: the exact closed form (solve_single_class) — O(sort(M)).
@@ -322,38 +341,52 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
     badly on identical costs (all classes chase the same columns in
     lockstep) — collapses to the exact C=1 closed form plus an
     arbitrary-but-feasible split of grants among classes.
-    Returns (y, converged).
+
+    pm0: optional carried machine prices [Mp1] (previous round's pm)
+    warm-starting the solve; any value is valid, a near-optimal one
+    makes the discharge a handful of supersteps.
+
+    Returns (y, pm, converged) — pm is the final machine-price vector
+    (zeros on the closed-form paths, where prices aren't computed).
     """
     C, Mp1 = wS.shape
     i32 = jnp.int32
     if C == 1:
         y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
-        return y, jnp.bool_(True)
+        return y, jnp.zeros_like(col_cap), jnp.bool_(True)
     if class_degenerate:
         y_tot = solve_single_class(wS[0], jnp.sum(supply), col_cap)
-        return split_grants_by_class(y_tot, supply), jnp.bool_(True)
+        return (
+            split_grants_by_class(y_tot, supply),
+            jnp.zeros_like(col_cap),
+            jnp.bool_(True),
+        )
 
     eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
     from ..ops import transport_solve
 
     if eps0 is None:
-        y, _steps, converged = transport_solve(
-            wS, supply, col_cap, eps_full, alpha=alpha, max_supersteps=num_supersteps
+        y, pm, _steps, converged = transport_solve(
+            wS, supply, col_cap, eps_full, pm0,
+            alpha=alpha, max_supersteps=num_supersteps,
         )
-        return y, converged
+        return y, pm, converged
 
-    y1, _s1, conv1 = transport_solve(
-        wS, supply, col_cap, i32(eps0), alpha=alpha, max_supersteps=num_supersteps
+    y1, pm1, _s1, conv1 = transport_solve(
+        wS, supply, col_cap, i32(eps0), pm0,
+        alpha=alpha, max_supersteps=num_supersteps,
     )
 
     def keep(_):
-        return y1, conv1
+        return y1, pm1, conv1
 
     def retry(_):
-        y2, _s2, conv2 = transport_solve(
-            wS, supply, col_cap, eps_full, alpha=alpha, max_supersteps=num_supersteps
+        # Cold restart: full eps range, no carried prices.
+        y2, pm2, _s2, conv2 = transport_solve(
+            wS, supply, col_cap, eps_full, None,
+            alpha=alpha, max_supersteps=num_supersteps,
         )
-        return y2, conv2
+        return y2, pm2, conv2
 
     return lax.cond(conv1, keep, retry, operand=None)
 
@@ -364,14 +397,15 @@ def _solve_transport(
     supply,  # int32[C]
     col_cap,  # int32[Mp1]
     eps_init,  # int32 scalar
+    pm0=None,  # optional int32[Mp1] carried machine prices
     alpha: int = 8,
     max_supersteps: int = 20_000,
 ):
     U = jnp.minimum(supply[:, None], col_cap[None, :])  # fwd arc capacity
-    y, z, steps, converged = _transport_loop(
-        wS, U, supply, col_cap, eps_init, alpha, max_supersteps
+    y, z, pm, steps, converged = _transport_loop(
+        wS, U, supply, col_cap, eps_init, alpha, max_supersteps, pm_init=pm0
     )
-    return y, steps, converged
+    return y, pm, steps, converged
 
 
 class LayeredTransportSolver:
@@ -437,17 +471,21 @@ class LayeredTransportSolver:
             self.last_supersteps = 0
         else:
             # Multi-class: cost-scaling push-relabel on device. Start the
-            # schedule at eps = n_scale (one original cost unit): valid
-            # for any eps0 since tightened potentials make the zero flow
-            # 0-optimal, and measurably ~2-3x fewer supersteps than
-            # starting from max|w| on contended instances. Fall back to
-            # the full-range schedule if the short one stalls.
+            # schedule at eps = n_scale/16 — valid for any eps0 since
+            # tightened potentials make the zero flow 0-optimal, and
+            # measured ~5x fewer supersteps than starting at one
+            # original cost unit (n_scale) on contended interference
+            # instances, itself ~20x better than starting from max|w|.
+            # Cold-started every round: carrying prices across rounds
+            # flattens reduced costs and recreates the herding pathology
+            # (measured 20x slower — see scheduler/device_bulk.py). Fall
+            # back to the full-range schedule if the short one stalls.
             eps_full = np.int32(max(1, max_w * n_scale))
             wS_d = jnp.asarray(wS)
             sup_d = jnp.asarray(supply.astype(np.int32))
             cap_d = jnp.asarray(col_cap.astype(np.int32))
             attempts = [
-                (np.int32(n_scale), self.max_supersteps),
+                (np.int32(max(1, n_scale // 16)), self.max_supersteps),
                 (eps_full, self.max_supersteps),
             ]
             from ..ops import transport_solve
@@ -455,7 +493,7 @@ class LayeredTransportSolver:
             y = steps = None
             converged = False
             for eps_init, cap_steps in attempts:
-                y, steps, converged = transport_solve(
+                y, _pm, steps, converged = transport_solve(
                     wS_d, sup_d, cap_d, jnp.asarray(eps_init),
                     alpha=self.alpha,
                     max_supersteps=cap_steps,

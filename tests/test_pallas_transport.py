@@ -52,17 +52,53 @@ def test_kernel_flow_identical_to_xla_loop(seed, C, M):
     wS, supply, col_cap, n_scale = _random_instance(seed, C, M)
     eps0 = np.int32(max(1, np.abs(wS).max()))
     U = jnp.minimum(jnp.asarray(supply)[:, None], jnp.asarray(col_cap)[None, :])
-    y_xla, _z, steps_xla, conv_xla = _transport_loop(
+    y_xla, _z, pm_xla, steps_xla, conv_xla = _transport_loop(
         jnp.asarray(wS), U, jnp.asarray(supply), jnp.asarray(col_cap),
         jnp.asarray(eps0), 8, 20_000,
     )
-    y_pl, steps_pl, conv_pl = transport_loop_pallas(
+    y_pl, pm_pl, steps_pl, conv_pl = transport_loop_pallas(
         jnp.asarray(wS), jnp.asarray(supply), jnp.asarray(col_cap),
         jnp.asarray(eps0), alpha=8, max_supersteps=20_000, interpret=True,
     )
     assert bool(conv_xla) and bool(conv_pl)
     assert int(steps_xla) == int(steps_pl)
     np.testing.assert_array_equal(np.asarray(y_xla), np.asarray(y_pl))
+    np.testing.assert_array_equal(np.asarray(pm_xla), np.asarray(pm_pl))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_warm_start_stays_exact(seed):
+    """Re-solving a perturbed instance from the previous solve's machine
+    prices must stay exactly optimal (same objective as cold). No
+    superstep-count guarantee exists — warm prices can be slower (they
+    flatten reduced costs; see scheduler/device_bulk.py), which is why
+    production solves are cold — but correctness must never depend on
+    the start point."""
+    C, M = 4, 60
+    wS, supply, col_cap, n_scale = _random_instance(seed, C, M)
+    eps0 = jnp.asarray(np.int32(n_scale))
+    a = (jnp.asarray(wS), jnp.asarray(supply), jnp.asarray(col_cap))
+    y0, pm0, s0, c0 = transport_loop_pallas(
+        *a, eps0, alpha=8, max_supersteps=50_000, interpret=True
+    )
+    assert bool(c0)
+    # perturb: a few tasks of each class finish, a few arrive
+    rng = np.random.default_rng(seed + 100)
+    supply2 = np.maximum(0, supply + rng.integers(-3, 4, C)).astype(np.int32)
+    cap2 = col_cap.copy()
+    cap2[-1] = supply2.sum()
+    a2 = (jnp.asarray(wS), jnp.asarray(supply2), jnp.asarray(cap2))
+    y_cold, _pm, s_cold, c_cold = transport_loop_pallas(
+        *a2, eps0, alpha=8, max_supersteps=50_000, interpret=True
+    )
+    y_warm, _pm2, s_warm, c_warm = transport_loop_pallas(
+        *a2, eps0, pm0, alpha=8, max_supersteps=50_000, interpret=True
+    )
+    assert bool(c_cold) and bool(c_warm)
+    w = wS.astype(np.int64)
+    obj_cold = int((np.asarray(y_cold) * w).sum())
+    obj_warm = int((np.asarray(y_warm) * w).sum())
+    assert obj_warm == obj_cold  # warm start never sacrifices optimality
 
 
 @pytest.mark.parametrize("seed", [0, 3])
