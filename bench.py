@@ -255,7 +255,8 @@ def run_config(args) -> None:
         from ksched_tpu.solver.layered import LayeredTransportSolver
 
         machines, events = synthesize_trace(
-            num_machines=12_500, num_tasks=60_000, duration_s=600.0, seed=11
+            num_machines=12_500, num_tasks=60_000, duration_s=600.0, seed=11,
+            machine_churn=0.02,
         )
         driver = TraceReplayDriver(
             machines, backend=LayeredTransportSolver(), slots_per_machine=8

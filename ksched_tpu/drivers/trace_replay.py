@@ -103,15 +103,40 @@ def synthesize_trace(
     duration_s: float = 600.0,
     mean_runtime_s: float = 120.0,
     seed: int = 0,
+    machine_churn: float = 0.0,
+    outage_s: float = 60.0,
 ) -> Tuple[List[TraceMachineEvent], List[TraceTaskEvent]]:
     """Fabricate machine/task event streams in the clusterdata-2011
     schema: machines ADD at t=0, Poisson task arrivals, exponential
-    runtimes emitting SUBMIT then FINISH."""
+    runtimes emitting SUBMIT then FINISH. A `machine_churn` fraction of
+    machines additionally suffers a mid-trace outage (REMOVE, then ADD
+    ~outage_s later — the real trace's dominant machine-event pattern),
+    so replay exercises eviction + rescheduling, not just placement.
+    Defaults to 0 so seeded streams stay reproducible for existing
+    callers; opt in explicitly (the churn draws precede the arrival
+    draws, so enabling it changes the whole stream for a seed)."""
     rng = np.random.default_rng(seed)
     machines = [
         TraceMachineEvent(time_us=0, machine_id=m + 1, event_type=MACHINE_ADD)
         for m in range(num_machines)
     ]
+    n_churn = int(num_machines * machine_churn)
+    if n_churn:
+        down = rng.choice(num_machines, n_churn, replace=False)
+        downtimes = rng.uniform(0.1 * duration_s, 0.8 * duration_s, n_churn)
+        for m, t_down in zip(down, downtimes):
+            t0 = int(t_down * 1e6)
+            machines.append(
+                TraceMachineEvent(time_us=t0, machine_id=int(m) + 1,
+                                  event_type=MACHINE_REMOVE)
+            )
+            back = t0 + int(rng.exponential(outage_s) * 1e6)
+            if back < duration_s * 1e6:
+                machines.append(
+                    TraceMachineEvent(time_us=back, machine_id=int(m) + 1,
+                                      event_type=MACHINE_ADD)
+                )
+        machines.sort(key=lambda e: e.time_us)
     arrivals = np.sort(rng.uniform(0, duration_s * 1e6, num_tasks)).astype(np.int64)
     runtimes = (rng.exponential(mean_runtime_s, num_tasks) * 1e6).astype(np.int64)
     jobs = rng.integers(1, max(2, num_tasks // 50), num_tasks)

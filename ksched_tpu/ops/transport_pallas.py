@@ -80,7 +80,9 @@ def _transport_kernel(
     # (zeros reduce exactly to cold shortest-distance tightening; see
     # solver/layered.py transport_tighten) ---
     live = col_cap > 0
-    pm0 = jnp.where(live, pm_init, -_BIG_D)                       # [1, Mp]
+    # clamp carried prices so pm0 - wS cannot wrap int32 (see
+    # solver/layered.py transport_tighten)
+    pm0 = jnp.where(live, jnp.clip(pm_init, -_BIG_D, _BIG_D), -_BIG_D)
     has_arc = U > 0
     pr0 = jnp.max(jnp.where(has_arc, pm0 - wS, -_BIG_D), axis=1, keepdims=True)
     pr0 = jnp.where(jnp.any(has_arc, axis=1, keepdims=True), pr0, i32(0))

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..solver.layered import (
     COST_SCALE_LIMIT,
+    default_eps0,
     pad_geometry,
     solve_single_class,
     transport_fori,
@@ -65,7 +66,8 @@ def _batch_solve(wS, supply, col_cap, n_scale, alpha, max_supersteps,
     def one(args):
         w, s, cap = args
         y, _pm, conv = transport_fori(
-            w, s, cap, max_supersteps, alpha=alpha, eps0=n_scale,
+            w, s, cap, max_supersteps, alpha=alpha,
+            eps0=default_eps0(n_scale),
             class_degenerate=class_degenerate,
         )
         return y, conv

@@ -38,7 +38,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..solver.layered import COST_SCALE_LIMIT, pad_geometry, transport_fori
+from ..solver.layered import (
+    COST_SCALE_LIMIT,
+    default_eps0,
+    pad_geometry,
+    transport_fori,
+)
 
 
 class DeviceClusterState(NamedTuple):
@@ -170,7 +175,7 @@ class DeviceBulkCluster:
             # fallback to the full schedule covers pathologies).
             y, _pm, converged = transport_fori(
                 wS, supply, col_cap, supersteps,
-                eps0=max(1, n_scale // 16),
+                eps0=default_eps0(n_scale),
                 class_degenerate=cost_fn is None,
             )
             y_real = y[:, :M]
