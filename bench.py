@@ -140,10 +140,13 @@ def _device_bench(
         got = dev.fetch_stats(stats)
         assert got["converged"].all(), "a steady round did not converge"
         if verbose:
+            ss = got.get("supersteps")
             print(
                 f"# chunk {rep}: {per_round_ms[rep]:.3f} ms/round x {R} rounds, "
                 f"placed/round mean {got['placed'].mean():.1f}, "
-                f"live {int(got['live'][-1])}",
+                f"live {int(got['live'][-1])}"
+                + (f", supersteps mean {ss.mean():.0f} max {int(ss.max())}"
+                   if ss is not None else ""),
                 file=sys.stderr,
             )
 

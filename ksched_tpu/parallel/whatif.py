@@ -65,7 +65,7 @@ def _batch_solve(wS, supply, col_cap, n_scale, alpha, max_supersteps,
 
     def one(args):
         w, s, cap = args
-        y, _pm, conv = transport_fori(
+        y, _pm, _steps, conv = transport_fori(
             w, s, cap, max_supersteps, alpha=alpha,
             eps0=default_eps0(n_scale),
             class_degenerate=class_degenerate,

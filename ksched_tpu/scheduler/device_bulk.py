@@ -173,7 +173,7 @@ class DeviceBulkCluster:
             # interference-model instances, still exactly optimal (any
             # eps0 is valid off tightened potentials; the in-graph
             # fallback to the full schedule covers pathologies).
-            y, _pm, converged = transport_fori(
+            y, _pm, solve_steps, converged = transport_fori(
                 wS, supply, col_cap, supersteps,
                 eps0=default_eps0(n_scale),
                 class_degenerate=cost_fn is None,
@@ -249,6 +249,10 @@ class DeviceBulkCluster:
                 "cost_overflow": cost_overflow,
                 "objective": objective,
                 "live": jnp.sum(state.live, dtype=i32),
+                # solver supersteps this round (0 on closed-form paths)
+                # — the observability the reference parses and discards
+                # (placement/solver.go:169-170)
+                "supersteps": solve_steps,
             }
             return state._replace(pu=new_pu, pu_running=pu_running), stats
 

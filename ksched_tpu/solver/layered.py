@@ -358,19 +358,21 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
     warm-starting the solve; any value is valid, a near-optimal one
     makes the discharge a handful of supersteps.
 
-    Returns (y, pm, converged) — pm is the final machine-price vector
-    (zeros on the closed-form paths, where prices aren't computed).
+    Returns (y, pm, steps, converged) — pm is the final machine-price
+    vector and steps the executed superstep count (both zero on the
+    closed-form paths, where no iterations run).
     """
     C, Mp1 = wS.shape
     i32 = jnp.int32
     if C == 1:
         y = solve_single_class(wS[0], supply[0], col_cap)[None, :]
-        return y, jnp.zeros_like(col_cap), jnp.bool_(True)
+        return y, jnp.zeros_like(col_cap), i32(0), jnp.bool_(True)
     if class_degenerate:
         y_tot = solve_single_class(wS[0], jnp.sum(supply), col_cap)
         return (
             split_grants_by_class(y_tot, supply),
             jnp.zeros_like(col_cap),
+            i32(0),
             jnp.bool_(True),
         )
 
@@ -378,27 +380,26 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
     from ..ops import transport_solve
 
     if eps0 is None:
-        y, pm, _steps, converged = transport_solve(
+        return transport_solve(
             wS, supply, col_cap, eps_full, pm0,
             alpha=alpha, max_supersteps=num_supersteps,
         )
-        return y, pm, converged
 
-    y1, pm1, _s1, conv1 = transport_solve(
+    y1, pm1, s1, conv1 = transport_solve(
         wS, supply, col_cap, i32(eps0), pm0,
         alpha=alpha, max_supersteps=num_supersteps,
     )
 
     def keep(_):
-        return y1, pm1, conv1
+        return y1, pm1, s1, conv1
 
     def retry(_):
         # Cold restart: full eps range, no carried prices.
-        y2, pm2, _s2, conv2 = transport_solve(
+        y2, pm2, s2, conv2 = transport_solve(
             wS, supply, col_cap, eps_full, None,
             alpha=alpha, max_supersteps=num_supersteps,
         )
-        return y2, pm2, conv2
+        return y2, pm2, s1 + s2, conv2
 
     return lax.cond(conv1, keep, retry, operand=None)
 
