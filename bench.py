@@ -63,6 +63,7 @@ def _device_bench(
     supersteps=None,
     unsched_cost: int = 5,
     ec_cost: int = 2,
+    decode_width=None,
     label: str = "trivial cost model",
     verbose: bool = False,
 ) -> dict:
@@ -99,6 +100,7 @@ def _device_bench(
         supersteps=supersteps,
         unsched_cost=unsched_cost,
         ec_cost=ec_cost,
+        decode_width=decode_width,
     )
     devices = jax.devices()
     churn_n = max(1, int(tasks * churn))
@@ -233,6 +235,7 @@ def run_config(args) -> None:
             unsched_cost=coco.UNSCHEDULED_COST,
             ec_cost=0,
             supersteps=1 << 17,
+            decode_width=4096,
             label="CoCo interference cost model (4 classes)",
             verbose=args.verbose,
         )
@@ -250,6 +253,7 @@ def run_config(args) -> None:
             unsched_cost=whare.UNSCHEDULED_COST,
             ec_cost=0,
             supersteps=1 << 17,
+            decode_width=2048,
             label="Whare-Map cost model, heterogeneous platforms",
             verbose=args.verbose,
         )

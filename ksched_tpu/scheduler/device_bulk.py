@@ -70,6 +70,7 @@ class DeviceBulkCluster:
         ec_cost: int = 2,
         class_cost_fn: Optional[Callable] = None,  # census[M,C] -> int32[C,M], traceable
         supersteps: Optional[int] = None,
+        decode_width: Optional[int] = None,  # steady-round decode window
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -81,6 +82,14 @@ class DeviceBulkCluster:
         self.unsched_cost = int(unsched_cost)
         self.ec_cost = int(ec_cost)
         self.class_cost_fn = class_cost_fn
+        if decode_width is not None:
+            if decode_width <= 0:
+                raise ValueError(
+                    f"decode_width must be positive, got {decode_width}"
+                )
+            if decode_width > task_capacity:
+                decode_width = None  # wider than the pool = the full path
+        self.decode_width = None if decode_width is None else int(decode_width)
         # C == 1 uses the exact closed form (no iterations); C >= 2 runs
         # the cost-scaling schedule under a lax.while_loop that exits on
         # convergence — this is only the safety bound, not the cost.
@@ -126,7 +135,20 @@ class DeviceBulkCluster:
             flat = jnp.zeros(M * C + 1, i32).at[idx].add(1)
             return flat[: M * C].reshape(M, C)
 
-        def round_core(state: DeviceClusterState):
+        def round_core(state: DeviceClusterState, decode_width=None,
+                       window_offset=None):
+            """One scheduling round. decode_width (static) bounds the
+            decode to a compacted window of that many unplaced rows —
+            the admission-batch bound (the reference bounds per-round
+            work the same way via pod batching, k8sclient/client.go:
+            153-193): tasks beyond the window stay pending for a later
+            round. window_offset (traced scalar) rotates which backlog
+            ranks the window covers; steady rounds pass a random offset
+            so solver-escaped tasks parked in low rows cannot occupy
+            the window forever and starve placeable tasks behind them.
+            With decode_width=None the decode spans all Tcap rows (the
+            fill path). Bounding matters at 50k+ tasks: the decode's
+            [width, M] passes dominate the non-solve round cost."""
             pu_free = jnp.where(
                 jnp.repeat(state.machine_enabled, P),
                 S - state.pu_running,
@@ -135,8 +157,39 @@ class DeviceBulkCluster:
             machine_free = pu_free.reshape(M, P).sum(axis=1)
 
             unplaced = state.live & (state.pu < 0)
+            backlog = jnp.sum(unplaced, dtype=i32)
+            if decode_width is None:
+                W = Tcap
+                idx = None  # identity window
+                valid = unplaced
+                cls_w = state.cls
+            else:
+                W = int(decode_width)
+                # compact W unplaced rows into the window: select the
+                # cyclic rank interval [off, off+W) of the backlog and
+                # find each rank's row by binary search in the running
+                # count (scatter-free; the [W] gathers that follow are
+                # cheap at W << Tcap). Ranks within the valid prefix are
+                # distinct, so no row enters the window twice.
+                cum_act = jnp.cumsum(unplaced.astype(i32))
+                backlog_i = cum_act[-1]
+                num_active = jnp.minimum(backlog_i, i32(W))
+                off = i32(0) if window_offset is None else window_offset
+                # rotate only when the window binds: a non-binding
+                # window covers the whole backlog anyway, and keeping
+                # row order makes the bounded path bit-identical to the
+                # full path in that regime
+                off = jnp.where(backlog_i > i32(W), off, i32(0))
+                denom = jnp.maximum(i32(1), backlog_i)
+                target = (off % denom + jnp.arange(W, dtype=i32)) % denom
+                idx = jnp.searchsorted(cum_act, target + 1).astype(i32)
+                valid = jnp.arange(W, dtype=i32) < num_active
+                idx = jnp.where(valid, jnp.clip(idx, 0, Tcap - 1), Tcap)
+                cls_w = jnp.where(
+                    valid, state.cls[jnp.clip(idx, 0, Tcap - 1)], i32(C)
+                )
             supply = jnp.stack(
-                [jnp.sum((state.cls == c) & unplaced, dtype=i32) for c in range(C)]
+                [jnp.sum((cls_w == c) & valid, dtype=i32) for c in range(C)]
             )
             total = jnp.sum(supply)
 
@@ -195,20 +248,20 @@ class DeviceBulkCluster:
             offs = jnp.cumsum(y_real, axis=0) - y_real  # [C, M]
 
             cols = jnp.arange(M, dtype=i32)[None, :]
-            # per-class ranks among unplaced rows ([Tcap]-sized, cheap);
+            # per-class ranks among the window's valid rows ([W]-sized);
             # classes partition tasks, so a masked sum merges them
-            rank = jnp.zeros(Tcap, i32)
-            placed_any = jnp.zeros(Tcap, jnp.bool_)
+            rank = jnp.zeros(W, i32)
+            placed_w = jnp.zeros(W, jnp.bool_)
             for c in range(C):
-                mask_c = unplaced & (state.cls == c)
+                mask_c = valid & (cls_w == c)
                 r = jnp.cumsum(mask_c.astype(i32)) - 1
                 rank = jnp.where(mask_c, r, rank)
-                placed_any = placed_any | (mask_c & (r < jnp.sum(y_real[c])))
+                placed_w = placed_w | (mask_c & (r < jnp.sum(y_real[c])))
 
             onehot = (
-                (state.cls[:, None] == jnp.arange(C, dtype=i32)[None, :])
-                & unplaced[:, None]
-            ).astype(jnp.float32)  # [Tcap, C]
+                (cls_w[:, None] == jnp.arange(C, dtype=i32)[None, :])
+                & valid[:, None]
+            ).astype(jnp.float32)  # [W, C]
             # precision=HIGHEST: TPU f32 matmuls default to bf16 passes,
             # whose 8-bit mantissa corrupts counts beyond 256 — these
             # gathers carry cumulative grant counts up to Tcap.
@@ -219,32 +272,43 @@ class DeviceBulkCluster:
                 "tc,cm->tm", onehot, offs.astype(jnp.float32), precision=hi
             )
             rank_f = rank.astype(jnp.float32)
-            cmp = cum_sel <= rank_f[:, None]  # [Tcap, M]
+            cmp = cum_sel <= rank_f[:, None]  # [W, M]
             machine = jnp.sum(cmp, axis=1, dtype=i32)  # grant machine
             excl_at = jnp.max(jnp.where(cmp, cum_sel, 0.0), axis=1)
-            oh = machine[:, None] == cols  # [Tcap, M]
+            oh = machine[:, None] == cols  # [W, M]
             off_at = jnp.sum(jnp.where(oh, off_sel, 0.0), axis=1)
             slot = off_at + (rank_f - excl_at)  # within-machine slot
             cg_at = jnp.einsum(
                 "tm,mp->tp", oh.astype(jnp.float32), cumg, precision=hi
-            )  # [Tcap, P]; counts < 2^24, exact in f32 at HIGHEST
+            )  # [W, P]; counts < 2^24, exact in f32 at HIGHEST
             pu_in = jnp.sum(cg_at <= slot[:, None], axis=1)
             pu_abs = machine * P + pu_in.astype(i32)
-            new_pu = jnp.where(placed_any, pu_abs, state.pu)
 
-            idx = jnp.where(placed_any, new_pu, num_pus)
+            if idx is None:
+                # identity window: elementwise select, no scatter
+                new_pu = jnp.where(placed_w, pu_abs, state.pu)
+                pr_idx = jnp.where(placed_w, pu_abs, num_pus)
+            else:
+                # compacted window: scatter the W placements back (rows
+                # beyond Tcap — invalid/unplaced — are dropped)
+                tgt = jnp.where(placed_w, idx, Tcap)
+                new_pu = state.pu.at[tgt].set(pu_abs, mode="drop")
+                pr_idx = jnp.where(placed_w, pu_abs, num_pus)
             pu_running = (
                 jnp.zeros(num_pus + 1, i32)
-                .at[idx].add(1)[:num_pus]
+                .at[pr_idx].add(1)[:num_pus]
                 + state.pu_running
             )
-            placed_count = jnp.sum(placed_any, dtype=i32)
-            objective = i32(u_cost) * (total - jnp.sum(y_real)) + jnp.sum(
+            placed_count = jnp.sum(placed_w, dtype=i32)
+            # unscheduled counts the WHOLE backlog left pending (solver
+            # escapes + rows beyond the decode window) — matches the
+            # host BulkCluster's num_unsched accounting
+            objective = i32(u_cost) * (backlog - placed_count) + jnp.sum(
                 (cost_cm + i32(e_cost)) * y_real
             )
             stats = {
                 "placed": placed_count,
-                "unscheduled": total - jnp.sum(y_real),
+                "unscheduled": backlog - placed_count,
                 "converged": converged,
                 "cost_overflow": cost_overflow,
                 "objective": objective,
@@ -318,7 +382,7 @@ class DeviceBulkCluster:
             schedule. Entirely on device so rounds chain without host
             sync — the incremental re-solve regime Flowlessly's daemon
             mode serves in the reference (placement/solver.go:60-90)."""
-            k1, k2, k3 = jax.random.split(key, 3)
+            k1, k2, k3, k4 = jax.random.split(key, 4)
             placed = state.live & (state.pu >= 0)
             done = placed & (
                 jax.random.uniform(k1, (Tcap,)) < churn_prob
@@ -347,7 +411,15 @@ class DeviceBulkCluster:
                 pu=jnp.where(newmask, i32(-1), state.pu),
             )
             admitted = jnp.sum(newmask, dtype=i32)
-            state, stats = round_core(state)
+            # steady rounds bound the decode to the configured window;
+            # the one-shot round() keeps the full width (fill path).
+            # The random offset rotates the window over the backlog so
+            # no pending task can be starved by earlier-row escapees.
+            state, stats = round_core(
+                state,
+                decode_width=self.decode_width,
+                window_offset=jax.random.randint(k4, (), 0, 1 << 30),
+            )
             stats["completed"] = jnp.sum(done, dtype=i32)
             stats["admitted"] = admitted
             return state, stats

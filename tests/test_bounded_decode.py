@@ -1,0 +1,101 @@
+"""Bounded-decode steady rounds (DeviceBulkCluster decode_width): when
+the window doesn't bind, results are identical to the full-width path;
+when it binds, each round places at most `decode_width` tasks and the
+backlog drains across rounds."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
+
+
+def _cluster(decode_width, C=2, task_capacity=512, slots=2, seed=9):
+    cost = np.random.default_rng(seed).integers(0, 20, (C, 12)).astype(np.int32)
+    cost_d = jnp.asarray(cost)
+    return DeviceBulkCluster(
+        num_machines=12, pus_per_machine=2, slots_per_pu=slots, num_jobs=3,
+        num_task_classes=C, task_capacity=task_capacity,
+        class_cost_fn=lambda census: cost_d, unsched_cost=25,
+        decode_width=decode_width,
+    )
+
+
+@pytest.mark.parametrize("C", [1, 2])
+def test_unbinding_window_matches_full_path(C):
+    """Same seeds, same initial tasks: a window larger than any round's
+    backlog must produce identical steady-round stats to the full path."""
+    rng = np.random.default_rng(3)
+    jobs = rng.integers(0, 3, 40).astype(np.int32)
+    cls = rng.integers(0, C, 40).astype(np.int32)
+
+    def run(width):
+        dev = _cluster(width, C=C)
+        dev.add_tasks(40, jobs, cls)
+        dev.fetch_stats(dev.round())
+        return dev.fetch_stats(dev.run_steady_rounds(6, 0.15, 4, seed=7))
+
+    full = run(None)
+    bounded = run(256)
+    for k in full:
+        np.testing.assert_array_equal(full[k], bounded[k], err_msg=f"stat {k}")
+
+
+def test_binding_window_caps_and_drains():
+    """Backlog 90 >> window 16 with ample capacity: each steady round
+    places exactly 16 until the backlog drains; unscheduled reports the
+    full pending backlog, not just the solver's escapes."""
+    dev = _cluster(16, C=2, slots=4)  # 12*2*4 = 96 slots
+    rng = np.random.default_rng(0)
+    dev.add_tasks(90, rng.integers(0, 3, 90).astype(np.int32),
+                  rng.integers(0, 2, 90).astype(np.int32))
+    s = dev.fetch_stats(dev.run_steady_rounds(6, 0.0, 0, seed=1))
+    assert bool(np.asarray(s["converged"]).all())
+    np.testing.assert_array_equal(
+        np.asarray(s["placed"]), [16, 16, 16, 16, 16, 10]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s["unscheduled"]), [74, 58, 42, 26, 10, 0]
+    )
+    assert dev.num_placed_tasks == 90
+
+
+def test_window_wider_than_pool_is_full_path():
+    dev = _cluster(10_000, task_capacity=512)
+    assert dev.decode_width is None
+
+
+def test_invalid_width_rejected():
+    with pytest.raises(ValueError):
+        _cluster(0)
+    with pytest.raises(ValueError):
+        _cluster(-4)
+
+
+def test_rotating_window_defeats_escapee_starvation():
+    """Solver-escaped tasks parked in low rows must not pin the window:
+    class-0 tasks are unplaceable everywhere (cost > unsched), class-1
+    tasks are free to place. With a window smaller than the escapee
+    count, rotation must still let every class-1 task (admitted in
+    HIGHER rows) get placed within a few rounds."""
+    C = 2
+    cost = np.zeros((C, 12), np.int32)
+    cost[0, :] = 100  # class 0: placement always worse than unsched (25)
+    cost_d = jnp.asarray(cost)
+    dev = DeviceBulkCluster(
+        num_machines=12, pus_per_machine=2, slots_per_pu=2, num_jobs=3,
+        num_task_classes=C, task_capacity=512,
+        class_cost_fn=lambda census: cost_d, unsched_cost=25,
+        decode_width=8,
+    )
+    # rows 0..23: unplaceable class-0 escapees; rows 24..39: class-1
+    dev.add_tasks(24, np.zeros(24, np.int32), np.zeros(24, np.int32))
+    dev.add_tasks(16, np.zeros(16, np.int32), np.ones(16, np.int32))
+    s = dev.fetch_stats(dev.run_steady_rounds(32, 0.0, 0, seed=3))
+    assert bool(np.asarray(s["converged"]).all())
+    st = dev.fetch_state()
+    pu = np.asarray(st["pu"])
+    cls = np.asarray(st["cls"])
+    live = np.asarray(st["live"])
+    assert (pu[live & (cls == 1)] >= 0).all(), "a placeable task starved"
+    assert (pu[live & (cls == 0)] < 0).all()  # escapees correctly pend
