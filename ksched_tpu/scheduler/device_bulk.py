@@ -71,6 +71,7 @@ class DeviceBulkCluster:
         class_cost_fn: Optional[Callable] = None,  # census[M,C] -> int32[C,M], traceable
         supersteps: Optional[int] = None,
         decode_width: Optional[int] = None,  # steady-round decode window
+        alpha: int = 8,  # eps-schedule divisor for iterative solves
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -82,12 +83,13 @@ class DeviceBulkCluster:
         self.unsched_cost = int(unsched_cost)
         self.ec_cost = int(ec_cost)
         self.class_cost_fn = class_cost_fn
+        self.alpha = int(alpha)
         if decode_width is not None:
             if decode_width <= 0:
                 raise ValueError(
                     f"decode_width must be positive, got {decode_width}"
                 )
-            if decode_width > task_capacity:
+            if decode_width >= task_capacity:
                 decode_width = None  # wider than the pool = the full path
         self.decode_width = None if decode_width is None else int(decode_width)
         # C == 1 uses the exact closed form (no iterations); C >= 2 runs
@@ -124,6 +126,8 @@ class DeviceBulkCluster:
         n_scale = self.n_scale
         supersteps = self.supersteps
         cost_fn = self.class_cost_fn
+        alpha = self.alpha
+        steady_decode_width = self.decode_width
         i32 = jnp.int32
 
         def census_of(state: DeviceClusterState):
@@ -157,8 +161,8 @@ class DeviceBulkCluster:
             machine_free = pu_free.reshape(M, P).sum(axis=1)
 
             unplaced = state.live & (state.pu < 0)
-            backlog = jnp.sum(unplaced, dtype=i32)
             if decode_width is None:
+                backlog = jnp.sum(unplaced, dtype=i32)
                 W = Tcap
                 idx = None  # identity window
                 valid = unplaced
@@ -172,15 +176,15 @@ class DeviceBulkCluster:
                 # cheap at W << Tcap). Ranks within the valid prefix are
                 # distinct, so no row enters the window twice.
                 cum_act = jnp.cumsum(unplaced.astype(i32))
-                backlog_i = cum_act[-1]
-                num_active = jnp.minimum(backlog_i, i32(W))
+                backlog = cum_act[-1]  # one reduction serves window + stats
+                num_active = jnp.minimum(backlog, i32(W))
                 off = i32(0) if window_offset is None else window_offset
                 # rotate only when the window binds: a non-binding
                 # window covers the whole backlog anyway, and keeping
                 # row order makes the bounded path bit-identical to the
                 # full path in that regime
-                off = jnp.where(backlog_i > i32(W), off, i32(0))
-                denom = jnp.maximum(i32(1), backlog_i)
+                off = jnp.where(backlog > i32(W), off, i32(0))
+                denom = jnp.maximum(i32(1), backlog)
                 target = (off % denom + jnp.arange(W, dtype=i32)) % denom
                 idx = jnp.searchsorted(cum_act, target + 1).astype(i32)
                 valid = jnp.arange(W, dtype=i32) < num_active
@@ -228,6 +232,7 @@ class DeviceBulkCluster:
             # fallback to the full schedule covers pathologies).
             y, _pm, solve_steps, converged = transport_fori(
                 wS, supply, col_cap, supersteps,
+                alpha=alpha,
                 eps0=default_eps0(n_scale),
                 class_degenerate=cost_fn is None,
             )
@@ -417,7 +422,7 @@ class DeviceBulkCluster:
             # no pending task can be starved by earlier-row escapees.
             state, stats = round_core(
                 state,
-                decode_width=self.decode_width,
+                decode_width=steady_decode_width,
                 window_offset=jax.random.randint(k4, (), 0, 1 << 30),
             )
             stats["completed"] = jnp.sum(done, dtype=i32)
