@@ -1,4 +1,5 @@
 from .sharded_solver import ShardedJaxSolver, ShardedPlan, build_sharded_plan, make_sharded_solver
+from .sharded_transport import ShardedLayeredSolver, sharded_transport_solve
 from .whatif import (
     ScenarioBatchResult,
     WhatIfSolver,
@@ -8,6 +9,8 @@ from .whatif import (
 
 __all__ = [
     "ShardedJaxSolver",
+    "ShardedLayeredSolver",
+    "sharded_transport_solve",
     "ShardedPlan",
     "build_sharded_plan",
     "make_sharded_solver",

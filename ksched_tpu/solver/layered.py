@@ -421,6 +421,84 @@ def _solve_transport(
     return y, pm, steps, converged
 
 
+def solve_layered_host(lp: LayeredProblem, *, pad, solve,
+                       max_supersteps: int) -> LayeredResult:
+    """The shared host harness around a device transport solve: cost
+    shift (subtract the unsched cost so the escape column is 0), padded
+    geometry, int32 overflow guard, closed-form dispatch for C==1 and
+    class-degenerate instances, the short-then-full eps attempts loop,
+    and objective reconstruction. One definition so the single-device
+    and mesh-sharded solvers cannot drift.
+
+    pad(M, C) -> (Mp, n_scale); solve(wS, supply, col_cap, eps_init)
+    -> (y, steps, converged) on device arrays."""
+    C, M = lp.cost_cm.shape
+    supply = lp.supply.astype(np.int64)
+    total = int(supply.sum())
+    if total == 0:
+        return LayeredResult(
+            y=np.zeros((C, M), np.int64), num_unsched=0, objective=0, supersteps=0
+        )
+    # Shifted per-unit cost: placing costs (e + cost[c,m]), leaving
+    # unscheduled costs u; subtract u so the unsched column is 0.
+    w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - int(lp.unsched_cost)
+    Mp, n_scale = pad(M, C)
+    wP = np.zeros((C, Mp), np.int64)
+    wP[:, :M] = w
+    col_cap = np.zeros(Mp, np.int64)
+    col_cap[:M] = lp.col_cap
+    col_cap[-1] = total
+
+    max_w = int(np.abs(wP).max())
+    if max_w * n_scale >= COST_SCALE_LIMIT:
+        raise OverflowError(
+            f"scaled layered costs overflow int32: max|w|={max_w} * {n_scale}"
+        )
+
+    if C == 1:
+        y_np = solve_single_class_np(wP[0], total, col_cap)[None, :]
+        steps_taken = 0
+    elif (wP == wP[0]).all():
+        # Class-degenerate (all cost rows equal): exact closed form on
+        # the total supply, grants split arbitrarily by class — the
+        # iterative solve herds pathologically on identical costs.
+        y_tot = solve_single_class_np(wP[0], total, col_cap)
+        y_np = split_grants_by_class(y_tot, supply)
+        steps_taken = 0
+    else:
+        wS = jnp.asarray((wP * n_scale).astype(np.int32))
+        sup = jnp.asarray(supply.astype(np.int32))
+        cap = jnp.asarray(col_cap.astype(np.int32))
+        attempts = [
+            np.int32(default_eps0(n_scale)),
+            np.int32(max(1, max_w * n_scale)),
+        ]
+        y = steps = None
+        converged = False
+        for eps_init in attempts:
+            y, steps, converged = solve(wS, sup, cap, jnp.asarray(eps_init))
+            if bool(converged):
+                break
+        steps_taken = int(steps)
+        if not bool(converged):
+            raise RuntimeError(
+                f"layered transport solve did not converge in "
+                f"{max_supersteps} supersteps"
+            )
+        y_np = np.asarray(y).astype(np.int64)
+    y_real = y_np[:, :M]
+    placed = int(y_real.sum())
+    objective = int(lp.unsched_cost) * (total - placed) + int(
+        ((lp.cost_cm.astype(np.int64) + int(lp.ec_cost)) * y_real).sum()
+    )
+    return LayeredResult(
+        y=y_real,
+        num_unsched=total - placed,
+        objective=objective,
+        supersteps=steps_taken,
+    )
+
+
 class LayeredTransportSolver:
     """The bulk scheduler's production TPU backend.
 
@@ -433,6 +511,9 @@ class LayeredTransportSolver:
     """
 
     def __init__(self, alpha: int = 8, max_supersteps: int = 20_000):
+        if alpha < 2:
+            raise ValueError(f"alpha must be >= 2 (got {alpha}): the eps "
+                             "phase schedule would never shrink")
         self.alpha = alpha
         self.max_supersteps = max_supersteps
         self.last_supersteps = 0
@@ -441,93 +522,17 @@ class LayeredTransportSolver:
         pass
 
     def solve_layered(self, lp: LayeredProblem) -> LayeredResult:
-        C, M = lp.cost_cm.shape
-        supply = lp.supply.astype(np.int64)
-        total = int(supply.sum())
-        if total == 0:
-            self.last_supersteps = 0
-            return LayeredResult(
-                y=np.zeros((C, M), np.int64), num_unsched=0, objective=0, supersteps=0
+        from ..ops import transport_solve
+
+        def solve(wS, sup, cap, eps_init):
+            y, _pm, steps, converged = transport_solve(
+                wS, sup, cap, eps_init,
+                alpha=self.alpha, max_supersteps=self.max_supersteps,
             )
-        # Shifted per-unit cost: placing costs (e + cost[c,m]), leaving
-        # unscheduled costs u; subtract u so the unsched column is 0.
-        w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - int(lp.unsched_cost)
-        # Pad machines to a lane-friendly multiple of 128, then append
-        # the unsched column (cap = total supply, cost 0).
-        Mp, n_scale = pad_geometry(M, C)
-        wP = np.zeros((C, Mp), np.int64)
-        wP[:, :M] = w
-        wP[:, M:] = 0  # padding columns have cap 0; last col = unsched
-        col_cap = np.zeros(Mp, np.int64)
-        col_cap[:M] = lp.col_cap
-        col_cap[-1] = total
+            return y, steps, converged
 
-        max_w = int(np.abs(wP).max())
-        if max_w * n_scale >= COST_SCALE_LIMIT:
-            raise OverflowError(
-                f"scaled layered costs overflow int32: max|w|={max_w} * {n_scale}"
-            )
-        wS = (wP * n_scale).astype(np.int32)
-
-        if C == 1:
-            # Exact closed form, pure host numpy: sort + greedy fill of
-            # strictly-profitable capacity (see solve_single_class).
-            y_np = solve_single_class_np(wP[0], total, col_cap)[None, :]
-            self.last_supersteps = 0
-        elif (wP == wP[0]).all():
-            # Class-degenerate (all cost rows equal): exact closed form
-            # on the total supply, grants split arbitrarily by class —
-            # the iterative solve herds pathologically on identical
-            # costs, and no split can beat another.
-            y_tot = solve_single_class_np(wP[0], total, col_cap)
-            y_np = split_grants_by_class(y_tot, supply)
-            self.last_supersteps = 0
-        else:
-            # Multi-class: cost-scaling push-relabel on device. Start the
-            # schedule at eps = n_scale/16 — valid for any eps0 since
-            # tightened potentials make the zero flow 0-optimal, and
-            # measured ~5x fewer supersteps than starting at one
-            # original cost unit (n_scale) on contended interference
-            # instances, itself ~20x better than starting from max|w|.
-            # Cold-started every round: carrying prices across rounds
-            # flattens reduced costs and recreates the herding pathology
-            # (measured 20x slower — see scheduler/device_bulk.py). Fall
-            # back to the full-range schedule if the short one stalls.
-            eps_full = np.int32(max(1, max_w * n_scale))
-            wS_d = jnp.asarray(wS)
-            sup_d = jnp.asarray(supply.astype(np.int32))
-            cap_d = jnp.asarray(col_cap.astype(np.int32))
-            attempts = [
-                (np.int32(default_eps0(n_scale)), self.max_supersteps),
-                (eps_full, self.max_supersteps),
-            ]
-            from ..ops import transport_solve
-
-            y = steps = None
-            converged = False
-            for eps_init, cap_steps in attempts:
-                y, _pm, steps, converged = transport_solve(
-                    wS_d, sup_d, cap_d, jnp.asarray(eps_init),
-                    alpha=self.alpha,
-                    max_supersteps=cap_steps,
-                )
-                if bool(converged):
-                    break
-            self.last_supersteps = int(steps)
-            if not bool(converged):
-                raise RuntimeError(
-                    f"layered transport solve did not converge in "
-                    f"{self.max_supersteps} supersteps"
-                )
-            y_np = np.asarray(y).astype(np.int64)
-        y_real = y_np[:, :M]
-        placed = int(y_real.sum())
-        objective = int(lp.unsched_cost) * (total - placed) + int(
-            ((lp.cost_cm.astype(np.int64) + int(lp.ec_cost)) * y_real).sum()
+        res = solve_layered_host(
+            lp, pad=pad_geometry, solve=solve, max_supersteps=self.max_supersteps
         )
-        return LayeredResult(
-            y=y_real,
-            num_unsched=total - placed,
-            objective=objective,
-            supersteps=self.last_supersteps,
-        )
+        self.last_supersteps = res.supersteps
+        return res
