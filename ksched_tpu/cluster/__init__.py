@@ -4,18 +4,25 @@ Reference: k8s/ — a thin anti-corruption layer between the scheduler
 core and the cluster control plane (k8s/k8sclient/client.go:32-147,
 k8s/k8stype/types.go). The rebuild keeps the same boundary: the
 scheduler consumes pod/node events and emits bindings through the
-ClusterAPI protocol; backends are the in-process SyntheticClusterAPI
-(for benchmarks/tests — the role fakeMachines plays in the reference)
-and, where a kubernetes client is installed, a real adapter following
-the same informer → channel → debounced-batch shape.
+ClusterAPI protocol. Backends:
+
+- SyntheticClusterAPI — in-process channels (the fakeMachines role);
+- HTTPClusterAPI — the real-control-plane shape: HTTP watch loops
+  feeding the same channels, k8s Binding-subresource POSTs out;
+- FakeAPIServer — a loopback server speaking the API slice the
+  scheduler uses, for hermetic end-to-end runs over real sockets.
 """
 
 from .api import Binding, ClusterAPI, NodeEvent, PodEvent
+from .fake_apiserver import FakeAPIServer
+from .http_api import HTTPClusterAPI
 from .synthetic_api import SyntheticClusterAPI
 
 __all__ = [
     "Binding",
     "ClusterAPI",
+    "FakeAPIServer",
+    "HTTPClusterAPI",
     "NodeEvent",
     "PodEvent",
     "SyntheticClusterAPI",

@@ -1,0 +1,165 @@
+"""A loopback fake API server speaking the slice of the k8s API the
+scheduler uses: pending-pod listing (field-selector semantics), node
+listing, and the Binding subresource POST. Lets the HTTP adapter
+(cluster/http_api.py) and the scheduler service run end-to-end over
+real sockets with no cluster — the hermetic analogue of running the
+reference against a bare kube-apiserver with no kubelets
+(reference README.md:55-70).
+
+Side-door endpoints (prefixed /_test) play podgen and the node
+lifecycle: POST /_test/pods {"count": N}, POST /_test/nodes {...},
+GET /_test/bindings.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.pods: Dict[str, dict] = {}  # name -> spec
+        self.nodes: List[dict] = []
+        self.bindings: Dict[str, str] = {}  # pod -> node
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State  # set by FakeAPIServer
+
+    def log_message(self, *args) -> None:  # silence request logging
+        pass
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n).decode()) if n else {}
+
+    def do_GET(self) -> None:
+        st = self.state
+        if self.path.startswith("/api/v1/pods"):
+            with st.lock:
+                # field-selector semantics: only pods not yet bound
+                items = [
+                    {"metadata": {"name": name}, "spec": spec}
+                    for name, spec in st.pods.items()
+                    if name not in st.bindings
+                ]
+            self._json(200, {"kind": "PodList", "items": items})
+        elif self.path.startswith("/api/v1/nodes"):
+            with st.lock:
+                items = list(st.nodes)
+            self._json(200, {"kind": "NodeList", "items": items})
+        elif self.path == "/_test/bindings":
+            with st.lock:
+                self._json(200, dict(st.bindings))
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:
+        st = self.state
+        parts = self.path.strip("/").split("/")
+        # /api/v1/namespaces/{ns}/pods/{name}/binding
+        if (
+            len(parts) == 7
+            and parts[:3] == ["api", "v1", "namespaces"]
+            and parts[4] == "pods"
+            and parts[6] == "binding"
+        ):
+            body = self._read_body()
+            pod = parts[5]
+            node = body.get("target", {}).get("name", "")
+            with st.lock:
+                if pod not in st.pods:
+                    return self._json(404, {"error": f"pod {pod} not found"})
+                st.bindings[pod] = node
+            return self._json(201, {"kind": "Status", "status": "Success"})
+        if self.path == "/_test/pods":
+            body = self._read_body()
+            count = int(body.get("count", 1))
+            prefix = body.get("prefix", "pod")
+            spec = body.get("spec", {})
+            with st.lock:
+                start = len(st.pods)
+                for i in range(count):
+                    st.pods[f"{prefix}_{start + i}"] = dict(spec)
+            return self._json(201, {"created": count})
+        if self.path == "/_test/nodes":
+            body = self._read_body()
+            with st.lock:
+                st.nodes.append(
+                    {
+                        "metadata": {"name": body["name"]},
+                        "spec": {"unschedulable": bool(body.get("unschedulable"))},
+                        "status": {"capacity": body.get("capacity", {})},
+                    }
+                )
+            return self._json(201, {"ok": True})
+        self._json(404, {"error": f"no route {self.path}"})
+
+
+class FakeAPIServer:
+    """Threaded loopback server; `base_url` after start()."""
+
+    def __init__(self) -> None:
+        self._state = _State()
+        handler = type("Handler", (_Handler,), {"state": self._state})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def base_url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeAPIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- convenience for tests/demos (the podgen/node side-door) -----------
+
+    def add_node(self, name: str, cores: int = 1, pus_per_core: int = 1,
+                 unschedulable: bool = False) -> None:
+        with self._state.lock:
+            self._state.nodes.append(
+                {
+                    "metadata": {"name": name},
+                    "spec": {"unschedulable": unschedulable},
+                    "status": {"capacity": {"cores": cores, "pus_per_core": pus_per_core}},
+                }
+            )
+
+    def create_pods(self, count: int, prefix: str = "pod", **spec) -> None:
+        with self._state.lock:
+            start = len(self._state.pods)
+            for i in range(count):
+                self._state.pods[f"{prefix}_{start + i}"] = dict(spec)
+
+    def bindings(self) -> Dict[str, str]:
+        with self._state.lock:
+            return dict(self._state.bindings)
+
+    def pending_pods(self) -> int:
+        with self._state.lock:
+            return sum(
+                1 for p in self._state.pods if p not in self._state.bindings
+            )

@@ -1,0 +1,147 @@
+"""HTTP transport for the cluster API: the real-control-plane adapter.
+
+Reference shape: the k8s client (k8s/k8sclient/client.go) runs informers
+against the API server (HTTP watches feeding channels, :49-105) and
+POSTs Binding subresources back (:128-147). This adapter is that
+pattern over the rebuild's ClusterAPI protocol:
+
+- two watch threads poll the pending-pods and nodes listings (the
+  informer analogue; field-selector semantics — only pods with no node
+  assignment — live server-side, exactly as the reference's selector
+  `spec.nodeName==""` does, client.go:53-60) and feed the same buffered
+  channels + debounce machinery the synthetic control plane uses;
+- `assign_bindings` POSTs one k8s-shaped Binding subresource per
+  placement: POST /api/v1/namespaces/{ns}/pods/{pod}/binding with a
+  {"target": {"kind": "Node", "name": node}} body (client.go:128-147).
+
+stdlib urllib only — no client dependencies. Pairs with
+cluster/fake_apiserver.py for hermetic tests and demos; pointing it at
+a real kube-apiserver needs only auth plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import List, Optional, Set
+
+from .api import Binding, ClusterAPI, NodeEvent, PodEvent
+from .synthetic_api import SyntheticClusterAPI
+
+
+class HTTPClusterAPI(ClusterAPI):
+    def __init__(
+        self,
+        base_url: str,
+        namespace: str = "default",
+        poll_interval_s: float = 0.2,
+        pod_chan_size: int = 5000,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.namespace = namespace
+        self.poll_interval_s = poll_interval_s
+        # The channel+debounce layer is shared with the synthetic
+        # control plane; this adapter only adds the HTTP watch/post.
+        self._chan = SyntheticClusterAPI(pod_chan_size=pod_chan_size)
+        self._seen_pods: Set[str] = set()
+        self._seen_nodes: Set[str] = set()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._watch_pods, daemon=True),
+            threading.Thread(target=self._watch_nodes, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    def _get_json(self, path: str) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(self.base_url + path, timeout=5) as r:
+                return json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError):
+            return None  # transient outage: informers keep retrying
+
+    # -- watch loops (informer analogue) -----------------------------------
+
+    def _watch_pods(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            got = self._get_json("/api/v1/pods?fieldSelector=spec.nodeName%3D%3D")
+            if not got:
+                continue
+            for item in got.get("items", []):
+                name = item["metadata"]["name"]
+                if name in self._seen_pods:
+                    continue
+                self._seen_pods.add(name)
+                spec = item.get("spec", {})
+                self._chan.submit_pod(
+                    PodEvent(
+                        pod_id=name,
+                        cpu_request=float(spec.get("cpu_request", 0.0)),
+                        net_bw_request=int(spec.get("net_bw_request", 0)),
+                        task_class=int(spec.get("task_class", 0)),
+                    )
+                )
+
+    def _watch_nodes(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            got = self._get_json("/api/v1/nodes")
+            if not got:
+                continue
+            for item in got.get("items", []):
+                if item.get("spec", {}).get("unschedulable"):
+                    continue  # reference skips unschedulable nodes (:91-95)
+                name = item["metadata"]["name"]
+                if name in self._seen_nodes:
+                    continue
+                self._seen_nodes.add(name)
+                cap = item.get("status", {}).get("capacity", {})
+                self._chan.submit_node(
+                    NodeEvent(
+                        node_id=name,
+                        num_cores=int(cap.get("cores", 1)),
+                        pus_per_core=int(cap.get("pus_per_core", 1)),
+                        net_bw_capacity=int(cap.get("net_bw", 0)),
+                    )
+                )
+
+    # -- ClusterAPI --------------------------------------------------------
+
+    def get_pod_batch(self, timeout_s: float) -> List[PodEvent]:
+        return self._chan.get_pod_batch(timeout_s)
+
+    def get_node_batch(self, timeout_s: float) -> List[NodeEvent]:
+        return self._chan.get_node_batch(timeout_s)
+
+    def assign_bindings(self, bindings: List[Binding]) -> None:
+        for b in bindings:
+            body = json.dumps(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Binding",
+                    "metadata": {"name": b.pod_id},
+                    "target": {"apiVersion": "v1", "kind": "Node", "name": b.node_id},
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"{self.base_url}/api/v1/namespaces/{self.namespace}"
+                f"/pods/{b.pod_id}/binding",
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except (urllib.error.URLError, OSError) as e:
+                # The reference logs and moves on (client.go:141-146);
+                # the pod stays pending and re-enters a later batch.
+                self._seen_pods.discard(b.pod_id)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._chan.close()
+        for t in self._threads:
+            t.join(timeout=2)

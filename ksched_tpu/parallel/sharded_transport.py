@@ -29,24 +29,23 @@ exactness argument).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..solver.layered import (
+    BIG as _BIG,
+    BIG_D as _BIG_D,
     LayeredProblem,
     LayeredResult,
     pad_geometry,
     solve_layered_host,
     transport_saturate,
+    validate_alpha,
 )
 
-_BIG = 1 << 30
-_BIG_D = 1 << 28
 AXIS = "x"
 
 
@@ -249,9 +248,13 @@ class ShardedLayeredSolver:
                 alpha=self.alpha, max_supersteps=self.max_supersteps,
             )
 
-        res = solve_layered_host(
-            lp, pad=self._pad_geometry, solve=solve,
-            max_supersteps=self.max_supersteps,
-        )
+        try:
+            res = solve_layered_host(
+                lp, pad=self._pad_geometry, solve=solve,
+                max_supersteps=self.max_supersteps,
+            )
+        except RuntimeError:
+            self.last_supersteps = self.max_supersteps  # budget exhausted
+            raise
         self.last_supersteps = res.supersteps
         return res

@@ -43,6 +43,7 @@ from ..solver.layered import (
     default_eps0,
     pad_geometry,
     transport_fori,
+    validate_alpha,
 )
 
 
@@ -83,10 +84,7 @@ class DeviceBulkCluster:
         self.unsched_cost = int(unsched_cost)
         self.ec_cost = int(ec_cost)
         self.class_cost_fn = class_cost_fn
-        if alpha < 2:
-            raise ValueError(f"alpha must be >= 2 (got {alpha}): the eps "
-                             "phase schedule would never shrink")
-        self.alpha = int(alpha)
+        self.alpha = validate_alpha(alpha)
         if decode_width is not None:
             if decode_width <= 0:
                 raise ValueError(

@@ -51,8 +51,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-_BIG = np.int32(1 << 30)
-_BIG_D = np.int32(1 << 28)
+#: sentinel magnitudes shared with parallel/sharded_transport.py — the
+#: sharded solve's bit-identity contract depends on matching fills
+BIG = 1 << 30
+BIG_D = 1 << 28
+_BIG = np.int32(BIG)
+_BIG_D = np.int32(BIG_D)
+
+
+def validate_alpha(alpha: int) -> int:
+    """alpha < 2 would make the eps phase schedule a fixed point and
+    hang the solve loop; one guard shared by every constructor that
+    accepts the knob."""
+    if alpha < 2:
+        raise ValueError(f"alpha must be >= 2 (got {alpha}): the eps "
+                         "phase schedule would never shrink")
+    return int(alpha)
 
 
 @dataclass
@@ -511,10 +525,7 @@ class LayeredTransportSolver:
     """
 
     def __init__(self, alpha: int = 8, max_supersteps: int = 20_000):
-        if alpha < 2:
-            raise ValueError(f"alpha must be >= 2 (got {alpha}): the eps "
-                             "phase schedule would never shrink")
-        self.alpha = alpha
+        self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.last_supersteps = 0
 
@@ -531,8 +542,13 @@ class LayeredTransportSolver:
             )
             return y, steps, converged
 
-        res = solve_layered_host(
-            lp, pad=pad_geometry, solve=solve, max_supersteps=self.max_supersteps
-        )
+        try:
+            res = solve_layered_host(
+                lp, pad=pad_geometry, solve=solve,
+                max_supersteps=self.max_supersteps,
+            )
+        except RuntimeError:
+            self.last_supersteps = self.max_supersteps  # budget exhausted
+            raise
         self.last_supersteps = res.supersteps
         return res
