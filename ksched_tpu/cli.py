@@ -121,6 +121,15 @@ class SchedulerService:
     # -- pod → task -------------------------------------------------------
 
     def _add_pod(self, pod: PodEvent) -> None:
+        existing = self.pod_to_task.get(pod.pod_id)
+        if existing is not None:
+            # Re-delivered pod (e.g. its binding POST failed, so the
+            # control plane still lists it pending): keep the existing
+            # task — a duplicate would double-occupy capacity — and
+            # forget the emitted binding so the next round's diff
+            # re-posts it.
+            self.old_bindings.pop(existing, None)
+            return
         td = add_task_to_job(self.job_id, self.job_map, self.task_map, name=pod.pod_id)
         td.resource_request.cpu_cores = pod.cpu_request
         td.resource_request.net_bw = pod.net_bw_request
@@ -183,10 +192,22 @@ class SchedulerService:
             rounds += 1
 
 
-def podgen(api: SyntheticClusterAPI, num_pods: int, net_bw: int = 0) -> None:
-    """Load generator (reference: cmd/podgen/podgen.go:34-74)."""
-    for i in range(num_pods):
-        api.submit_pod(PodEvent(pod_id=f"pod_{i}", net_bw_request=net_bw))
+def podgen(api: ClusterAPI, num_pods: int, net_bw: int = 0) -> None:
+    """Load generator (reference: cmd/podgen/podgen.go:34-74). Against
+    an HTTP control plane, pods are created via the API server (as the
+    reference's podgen does); against the synthetic one, enqueued
+    directly."""
+    try:
+        for i in range(num_pods):
+            if hasattr(api, "create_pod"):
+                api.create_pod(f"pod_{i}", net_bw_request=net_bw)
+            else:
+                api.submit_pod(PodEvent(pod_id=f"pod_{i}", net_bw_request=net_bw))
+    except Exception as e:  # noqa: BLE001 — runs in a daemon thread
+        # Surface the failure and unblock get_pod_batch (which would
+        # otherwise wait forever for pods that will never arrive).
+        print(f"podgen failed: {e}", file=sys.stderr)
+        api.close()
 
 
 def main(argv=None) -> int:
@@ -215,6 +236,12 @@ def main(argv=None) -> int:
                     help="generate N pods in-process (cmd/podgen equivalent)")
     ap.add_argument("--one-shot", action="store_true",
                     help="exit once the pod queue is drained")
+    ap.add_argument(
+        "--api-server", metavar="URL", default=None,
+        help="schedule against a control plane over HTTP (the reference's "
+        "-addr; see cluster/http_api.py) instead of the in-process "
+        "synthetic API; --podgen then posts pods to the server",
+    )
     args = ap.parse_args(argv)
     if args.one_shot and args.podgen <= 0:
         ap.error("--one-shot needs --podgen N: the pod wait blocks until a first pod arrives")
@@ -223,7 +250,12 @@ def main(argv=None) -> int:
 
     backend = make_backend(args.backend)
 
-    api = SyntheticClusterAPI(pod_chan_size=args.pod_chan_size)
+    if args.api_server:
+        from .cluster.http_api import HTTPClusterAPI
+
+        api = HTTPClusterAPI(args.api_server, pod_chan_size=args.pod_chan_size)
+    else:
+        api = SyntheticClusterAPI(pod_chan_size=args.pod_chan_size)
     svc = SchedulerService(
         api,
         max_tasks_per_pu=args.max_tasks_per_pu,
@@ -241,18 +273,21 @@ def main(argv=None) -> int:
     if args.podgen > 0:
         threading.Thread(target=podgen, args=(api, args.podgen), daemon=True).start()
 
-    if args.one_shot:
-        pods = api.get_pod_batch(args.pod_batch_timeout)
-        bound = svc.run_once(pods) if pods else 0
-        lat = svc.round_latencies_s[-1] * 1e3 if svc.round_latencies_s else 0.0
-        print(
-            f"scheduled {bound}/{len(pods)} pods in {lat:.2f}ms "
-            f"({len(api.bindings())} total bindings)",
-            file=sys.stderr,
-        )
+    try:
+        if args.one_shot:
+            pods = api.get_pod_batch(args.pod_batch_timeout)
+            bound = svc.run_once(pods) if pods else 0
+            lat = svc.round_latencies_s[-1] * 1e3 if svc.round_latencies_s else 0.0
+            print(
+                f"scheduled {bound}/{len(pods)} pods in {lat:.2f}ms "
+                f"({len(api.bindings())} total bindings)",
+                file=sys.stderr,
+            )
+            return 0
+        svc.run(pod_batch_timeout_s=args.pod_batch_timeout)
         return 0
-    svc.run(pod_batch_timeout_s=args.pod_batch_timeout)
-    return 0
+    finally:
+        api.close()
 
 
 if __name__ == "__main__":

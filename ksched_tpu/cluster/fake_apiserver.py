@@ -26,6 +26,24 @@ class _State:
         self.nodes: List[dict] = []
         self.bindings: Dict[str, str] = {}  # pod -> node
 
+    # shared by the HTTP handlers and the Python side-door so the two
+    # entry points cannot drift on object schema
+    def add_node(self, name: str, capacity: dict, unschedulable: bool) -> None:
+        with self.lock:
+            self.nodes.append(
+                {
+                    "metadata": {"name": name},
+                    "spec": {"unschedulable": bool(unschedulable)},
+                    "status": {"capacity": dict(capacity)},
+                }
+            )
+
+    def add_pods(self, count: int, prefix: str, spec: dict) -> None:
+        with self.lock:
+            start = len(self.pods)
+            for i in range(count):
+                self.pods[f"{prefix}_{start + i}"] = dict(spec)
+
 
 class _Handler(BaseHTTPRequestHandler):
     state: _State  # set by FakeAPIServer
@@ -84,26 +102,31 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._json(404, {"error": f"pod {pod} not found"})
                 st.bindings[pod] = node
             return self._json(201, {"kind": "Status", "status": "Success"})
+        # /api/v1/namespaces/{ns}/pods — pod creation (the podgen path,
+        # cmd/podgen/podgen.go:34-74 creates pods via the API server)
+        if (
+            len(parts) == 5
+            and parts[:3] == ["api", "v1", "namespaces"]
+            and parts[4] == "pods"
+        ):
+            body = self._read_body()
+            name = body.get("metadata", {}).get("name")
+            if not name:
+                return self._json(400, {"error": "metadata.name required"})
+            with st.lock:
+                st.pods[name] = dict(body.get("spec", {}))
+            return self._json(201, {"kind": "Pod", "metadata": {"name": name}})
         if self.path == "/_test/pods":
             body = self._read_body()
             count = int(body.get("count", 1))
-            prefix = body.get("prefix", "pod")
-            spec = body.get("spec", {})
-            with st.lock:
-                start = len(st.pods)
-                for i in range(count):
-                    st.pods[f"{prefix}_{start + i}"] = dict(spec)
+            st.add_pods(count, body.get("prefix", "pod"), body.get("spec", {}))
             return self._json(201, {"created": count})
         if self.path == "/_test/nodes":
             body = self._read_body()
-            with st.lock:
-                st.nodes.append(
-                    {
-                        "metadata": {"name": body["name"]},
-                        "spec": {"unschedulable": bool(body.get("unschedulable"))},
-                        "status": {"capacity": body.get("capacity", {})},
-                    }
-                )
+            st.add_node(
+                body["name"], body.get("capacity", {}),
+                bool(body.get("unschedulable")),
+            )
             return self._json(201, {"ok": True})
         self._json(404, {"error": f"no route {self.path}"})
 
@@ -139,20 +162,12 @@ class FakeAPIServer:
 
     def add_node(self, name: str, cores: int = 1, pus_per_core: int = 1,
                  unschedulable: bool = False) -> None:
-        with self._state.lock:
-            self._state.nodes.append(
-                {
-                    "metadata": {"name": name},
-                    "spec": {"unschedulable": unschedulable},
-                    "status": {"capacity": {"cores": cores, "pus_per_core": pus_per_core}},
-                }
-            )
+        self._state.add_node(
+            name, {"cores": cores, "pus_per_core": pus_per_core}, unschedulable
+        )
 
     def create_pods(self, count: int, prefix: str = "pod", **spec) -> None:
-        with self._state.lock:
-            start = len(self._state.pods)
-            for i in range(count):
-                self._state.pods[f"{prefix}_{start + i}"] = dict(spec)
+        self._state.add_pods(count, prefix, spec)
 
     def bindings(self) -> Dict[str, str]:
         with self._state.lock:

@@ -47,6 +47,8 @@ class HTTPClusterAPI(ClusterAPI):
         self._chan = SyntheticClusterAPI(pod_chan_size=pod_chan_size)
         self._seen_pods: Set[str] = set()
         self._seen_nodes: Set[str] = set()
+        self._posted_bindings: dict = {}
+        self._bindings_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads = [
             threading.Thread(target=self._watch_pods, daemon=True),
@@ -75,16 +77,19 @@ class HTTPClusterAPI(ClusterAPI):
                 name = item["metadata"]["name"]
                 if name in self._seen_pods:
                     continue
-                self._seen_pods.add(name)
                 spec = item.get("spec", {})
-                self._chan.submit_pod(
-                    PodEvent(
-                        pod_id=name,
-                        cpu_request=float(spec.get("cpu_request", 0.0)),
-                        net_bw_request=int(spec.get("net_bw_request", 0)),
-                        task_class=int(spec.get("task_class", 0)),
-                    )
+                event = PodEvent(
+                    pod_id=name,
+                    cpu_request=float(spec.get("cpu_request", 0.0)),
+                    net_bw_request=int(spec.get("net_bw_request", 0)),
+                    task_class=int(spec.get("task_class", 0)),
                 )
+                # bounded-wait offer so a full channel cannot wedge this
+                # thread past close(); an unoffered pod is re-listed
+                while not self._stop.is_set():
+                    if self._chan.offer_pod(event, timeout_s=0.2):
+                        self._seen_pods.add(name)
+                        break
 
     def _watch_nodes(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
@@ -116,6 +121,27 @@ class HTTPClusterAPI(ClusterAPI):
     def get_node_batch(self, timeout_s: float) -> List[NodeEvent]:
         return self._chan.get_node_batch(timeout_s)
 
+    def create_pod(self, pod_id: str, **spec) -> None:
+        """Create a pod via the control plane (the podgen path: the
+        reference's load generator POSTs pods to the API server,
+        cmd/podgen/podgen.go:34-74)."""
+        body = json.dumps(
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": pod_id}, "spec": spec}
+        ).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/api/v1/namespaces/{self.namespace}/pods",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def bindings(self) -> dict:
+        """Pod→node placements this adapter successfully posted."""
+        with self._bindings_lock:
+            return dict(self._posted_bindings)
+
     def assign_bindings(self, bindings: List[Binding]) -> None:
         for b in bindings:
             body = json.dumps(
@@ -135,10 +161,13 @@ class HTTPClusterAPI(ClusterAPI):
             )
             try:
                 urllib.request.urlopen(req, timeout=5).read()
-            except (urllib.error.URLError, OSError) as e:
+            except (urllib.error.URLError, OSError):
                 # The reference logs and moves on (client.go:141-146);
                 # the pod stays pending and re-enters a later batch.
                 self._seen_pods.discard(b.pod_id)
+            else:
+                with self._bindings_lock:
+                    self._posted_bindings[b.pod_id] = b.node_id
 
     def close(self) -> None:
         self._stop.set()

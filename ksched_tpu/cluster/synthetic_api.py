@@ -33,6 +33,16 @@ class SyntheticClusterAPI(ClusterAPI):
     def submit_pod(self, pod: PodEvent) -> None:
         self._pods.put(pod)
 
+    def offer_pod(self, pod: PodEvent, timeout_s: float) -> bool:
+        """Bounded-wait submit for producers that must stay responsive
+        to shutdown (the HTTP watch threads): returns False instead of
+        blocking past timeout_s when the channel is full."""
+        try:
+            self._pods.put(pod, timeout=timeout_s)
+            return True
+        except queue.Full:
+            return False
+
     def submit_node(self, node: NodeEvent) -> None:
         self._nodes.put(node)
 

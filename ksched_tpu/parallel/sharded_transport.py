@@ -224,11 +224,8 @@ class ShardedLayeredSolver:
 
     def __init__(self, mesh: Mesh, alpha: int = 8, max_supersteps: int = 1 << 17):
         assert AXIS in mesh.axis_names, f"mesh must have a {AXIS!r} axis"
-        if alpha < 2:
-            raise ValueError(f"alpha must be >= 2 (got {alpha}): the eps "
-                             "phase schedule would never shrink")
         self.mesh = mesh
-        self.alpha = alpha
+        self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.last_supersteps = 0
 
