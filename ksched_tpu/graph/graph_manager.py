@@ -70,6 +70,7 @@ class GraphManager:
         self.leaf_resource_ids = leaf_resource_ids  # shared with the cost model
         self.leaf_node_ids: Set[int] = set()
         self._cur_traversal_counter = 0
+        self._ec_purge_candidates: Set[int] = set()  # unconnected last purge
 
     # ------------------------------------------------------------------
     # Public lifecycle API (reference interface graph_manager.go:32-86)
@@ -162,10 +163,34 @@ class GraphManager:
         self.cm.delete_node(node, ChangeType.DEL_UNSCHED_JOB_NODE, "JobCompleted")
 
     def purge_unconnected_equiv_class_nodes(self) -> None:
-        """Reference: graph_manager.go:347-357."""
-        for node in list(self.task_ec_to_node.values()):
-            if not node.incoming:
-                self._remove_equiv_class_node(node)
+        """Remove equivalence-class nodes nothing points at (reference
+        declares this, graph_manager.go:347-357, but never calls it;
+        the scheduler here runs it per round).
+
+        Debounced: an EC must be unconnected on two consecutive calls
+        before removal, so ECs that are merely transiently unconnected
+        (e.g. every task pinned this round, new arrivals next round)
+        don't churn their wide EC->machine fan-outs through the change
+        journal each cycle. ECs orphaned by a removal within this call
+        (their only in-arcs came from a purged EC) are dead for certain
+        and cascade immediately — the reference's note about multi-call
+        subgraph cleanup (graph_manager.go:348-351) without leaving
+        chains behind if the cluster quiesces."""
+
+        def unconnected() -> set:
+            return {
+                ec for ec, node in self.task_ec_to_node.items() if not node.incoming
+            }
+
+        seen = unconnected()
+        doomed = seen & self._ec_purge_candidates
+        while doomed:
+            for ec in doomed:
+                self._remove_equiv_class_node(self.task_ec_to_node[ec])
+            now = unconnected()
+            doomed = now - seen  # newly orphaned by this wave: cascade
+            seen |= now
+        self._ec_purge_candidates = unconnected()
 
     def task_completed(self, task_id: int) -> int:
         """Reference: graph_manager.go:389-405."""

@@ -236,6 +236,11 @@ class FlowScheduler:
         num_scheduled = 0
         deltas: List[SchedulingDelta] = []
         if jds:
+            # Reset the mutation counters at round START (the reference
+            # resets after the round, flowscheduler/scheduler.go:332,
+            # which zeroes them before any post-round reader — e.g. the
+            # round tracer — can observe the round's mutation counts).
+            self.dimacs_stats.reset()
             t0 = time.perf_counter()
             self.gm.compute_topology_statistics(self.gm.sink_node)
             timing.stats_s = time.perf_counter() - t0
@@ -243,7 +248,13 @@ class FlowScheduler:
             self.gm.add_or_update_job_nodes(jds)
             timing.graph_update_s = time.perf_counter() - t0
             num_scheduled, deltas = self._run_scheduling_iteration(timing)
-            self.dimacs_stats.reset()
+            # Drop equivalence-class nodes nothing points at anymore so
+            # long-running deployments don't accumulate them. The
+            # reference declares this API but never calls it
+            # (graph_manager.go:347-357); upstream Firmament purges in
+            # its scheduling loop, which is the behavior kept here
+            # (debounced — see the graph manager's docstring).
+            self.gm.purge_unconnected_equiv_class_nodes()
             # Policy feedback: which runnable tasks stayed unscheduled
             # (drives e.g. Quincy's wait-cost starvation bound).
             unscheduled = [

@@ -39,8 +39,12 @@ class TwoLevelECModel(TrivialCostModel):
 
 
 def test_two_level_ec_chain_schedules_tasks():
+    # preemption on: running tasks keep their EC arcs, so the chain
+    # stays connected across the round (with preemption off, pinning
+    # drops the arcs and the per-round purge removes idle ECs — see
+    # test_pinned_round_purges_idle_ecs)
     sched, rmap, jmap, tmap, root = build_cluster(
-        num_machines=2, pus_per_core=2,
+        num_machines=2, pus_per_core=2, preemption=True,
         cost_model_factory=TwoLevelECModel,
     )
     add_job(sched, jmap, tmap, num_tasks=3)
@@ -81,7 +85,7 @@ def test_stale_ec_chain_is_pruned():
             return []
 
     sched, rmap, jmap, tmap, root = build_cluster(
-        num_machines=2, pus_per_core=2,
+        num_machines=2, pus_per_core=2, preemption=True,
         cost_model_factory=RetractableModel,
     )
     add_job(sched, jmap, tmap, num_tasks=1)
@@ -94,3 +98,24 @@ def test_stale_ec_chain_is_pruned():
     add_job(sched, jmap, tmap, num_tasks=1)  # forces a graph update pass
     sched.schedule_all_jobs()
     assert sched.gm.cm.graph.get_arc(job_node, rack_node) is None
+
+
+def test_pinned_round_purges_idle_ecs_with_debounce():
+    """With preemption OFF, placed tasks are pinned (their EC arcs
+    deleted), leaving the chain ECs unconnected. The purge is
+    debounced: one round of being unconnected marks them, a second
+    purge removes them — transiently idle aggregators don't churn, and
+    persistently idle ones don't accumulate. The cascade (RACK_EC
+    orphaned by JOB_EC's removal) resolves in the same call."""
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=2, pus_per_core=2,
+        cost_model_factory=TwoLevelECModel,
+    )
+    add_job(sched, jmap, tmap, num_tasks=3)
+    n, _ = sched.schedule_all_jobs()
+    assert n == 3
+    # everyone pinned; the round's purge only MARKED the idle ECs
+    assert JOB_EC in sched.gm.task_ec_to_node
+    sched.gm.purge_unconnected_equiv_class_nodes()  # second observation
+    assert not sched.gm.task_ec_to_node  # JOB_EC purged, RACK_EC cascaded
+    assert sched.gm.sink_node.excess == -len(sched.gm.task_to_node)

@@ -190,13 +190,22 @@ def test_dimacs_incremental_export_golden():
 
 
 def test_purge_unconnected_equiv_class_nodes():
+    """The per-round purge (beyond-parity: the reference declares the
+    API but never calls it, graph_manager.go:347-357; upstream
+    Firmament purges in its loop) removes the cluster-agg EC once every
+    task is pinned; a waiting task keeps it alive."""
     sched, rmap, jmap, tmap, root = build_cluster(num_machines=1, pus_per_core=1)
-    jid = add_job(sched, jmap, tmap, num_tasks=1)
+    add_job(sched, jmap, tmap, num_tasks=2)  # 1 slot: one pins, one waits
     sched.schedule_all_jobs()
-    assert sched.gm.task_ec_to_node  # cluster-agg EC exists
+    # the waiting task's EC arc keeps the aggregator connected
+    assert sched.gm.task_ec_to_node
     (tid,) = list(sched.task_bindings)
     sched.handle_task_completion(tmap.find(tid))
-    # the EC's only in-arc came from the (now pinned/removed) task
+    sched.schedule_all_jobs()  # sees pre-completion stats (1-round lag)
+    sched.schedule_all_jobs()  # places + pins the waiter
+    assert len(sched.task_bindings) == 1
+    # everyone pinned -> the round's purge marked the idle EC
+    # (debounce); a second observation removes it
     sched.gm.purge_unconnected_equiv_class_nodes()
     assert not sched.gm.task_ec_to_node
 

@@ -158,6 +158,9 @@ def test_tracer_records_flow_rounds(tmp_path):
     assert len(lines) == 3
     assert lines[0]["phases_ms"]["solve"] >= 0
     assert lines[0]["num_scheduled"] == 1
+    # the round's mutation counts are observable (stats reset at round
+    # START, not after — a post-round reset would zero these)
+    assert lines[0]["nodes_added"] > 0 and lines[0]["arcs_added"] > 0
 
 
 def test_tracer_records_bulk_rounds():
