@@ -123,11 +123,27 @@ class SchedulerService:
     def _add_pod(self, pod: PodEvent) -> None:
         existing = self.pod_to_task.get(pod.pod_id)
         if existing is not None:
-            # Re-delivered pod (e.g. its binding POST failed, so the
-            # control plane still lists it pending): keep the existing
-            # task — a duplicate would double-occupy capacity — and
-            # forget the emitted binding so the next round's diff
-            # re-posts it.
+            # Re-delivered pod: keep the existing task — a duplicate
+            # would double-occupy capacity — and forget the emitted
+            # binding so the next round's diff re-posts it. Two causes:
+            # a failed binding POST (spec unchanged), or a pod deleted
+            # and re-created under the same name (the watch reconcile
+            # re-surfaces it). For the latter the new spec must win:
+            # refresh the descriptor, and evict any stale placement so
+            # the next round reschedules under the new request.
+            td = self.task_map.find(existing)
+            if td is not None and (
+                td.resource_request.cpu_cores,
+                td.resource_request.net_bw,
+                int(td.task_type),
+            ) != (pod.cpu_request, pod.net_bw_request, pod.task_class):
+                td.resource_request.cpu_cores = pod.cpu_request
+                td.resource_request.net_bw = pod.net_bw_request
+                td.task_type = type(td.task_type)(pod.task_class)
+                rid = self.scheduler.task_bindings.get(existing)
+                if rid is not None:
+                    rs = self.resource_map.find(rid)
+                    self.scheduler.handle_task_eviction(td, rs.descriptor)
             self.old_bindings.pop(existing, None)
             return
         td = add_task_to_job(self.job_id, self.job_map, self.task_map, name=pod.pod_id)

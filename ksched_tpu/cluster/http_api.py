@@ -71,12 +71,23 @@ class HTTPClusterAPI(ClusterAPI):
     def _watch_pods(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
             got = self._get_json("/api/v1/pods?fieldSelector=spec.nodeName%3D%3D")
-            if not got:
+            if got is None:
                 continue
-            for item in got.get("items", []):
+            items = got.get("items", [])
+            listed = {item["metadata"]["name"] for item in items}
+            with self._bindings_lock:
+                # Reconcile against the listing: a name that left the
+                # pending set (bound, or deleted server-side) is
+                # forgotten, so a pod re-created with the same name is
+                # re-surfaced — and _seen_pods stays bounded by the
+                # listing size instead of growing forever.
+                self._seen_pods &= listed
+                fresh = [
+                    item for item in items
+                    if item["metadata"]["name"] not in self._seen_pods
+                ]
+            for item in fresh:
                 name = item["metadata"]["name"]
-                if name in self._seen_pods:
-                    continue
                 spec = item.get("spec", {})
                 event = PodEvent(
                     pod_id=name,
@@ -88,7 +99,8 @@ class HTTPClusterAPI(ClusterAPI):
                 # thread past close(); an unoffered pod is re-listed
                 while not self._stop.is_set():
                     if self._chan.offer_pod(event, timeout_s=0.2):
-                        self._seen_pods.add(name)
+                        with self._bindings_lock:
+                            self._seen_pods.add(name)
                         break
 
     def _watch_nodes(self) -> None:
@@ -164,7 +176,8 @@ class HTTPClusterAPI(ClusterAPI):
             except (urllib.error.URLError, OSError):
                 # The reference logs and moves on (client.go:141-146);
                 # the pod stays pending and re-enters a later batch.
-                self._seen_pods.discard(b.pod_id)
+                with self._bindings_lock:
+                    self._seen_pods.discard(b.pod_id)
             else:
                 with self._bindings_lock:
                     self._posted_bindings[b.pod_id] = b.node_id

@@ -16,11 +16,19 @@ Incremental lines additionally use
     r <id>                                      (remove node)
     x <src> <dst> <low> <cap> <cost> <type> <old cost>   (update arc)
 and each batch ends with "c EOI" (end of iteration).
+
+The solver's RESPONSE direction (flow assignments back to the
+scheduler) is
+    f <src> <dst> <flow>
+lines terminated by "c EOI" (reference: placement/solver.go:134-179
+readFlowGraph). export_flow/parse_flow below close that loop so an
+external DIMACS solver can serve as a parity oracle against the
+in-process backends.
 """
 
 from __future__ import annotations
 
-from typing import IO, Iterable, List
+from typing import IO, Dict, Iterable, List, Tuple
 
 from .changes import AddNodeChange, Change, ChangeArcChange, NewArcChange, RemoveNodeChange
 from .flowgraph import FlowGraph, NodeType
@@ -86,6 +94,64 @@ def export_incremental(changes: Iterable[Change], out: IO[str]) -> None:
             raise TypeError(f"unknown change record: {ch!r}")
     out.write("c EOI\n")
     out.flush()
+
+
+def export_flow(src, dst, flow, out: IO[str]) -> None:
+    """Write a solver flow response: one `f src dst flow` line per
+    positive-flow arc, then the `c EOI` terminator — the stdout side of
+    the reference solver protocol (placement/solver.go:134-179 parses
+    exactly this). src/dst/flow are parallel arrays/sequences."""
+    for s, d, f in zip(src, dst, flow):
+        if f > 0:
+            out.write(f"f {int(s)} {int(d)} {int(f)}\n")
+    out.write("c EOI\n")
+    out.flush()
+
+
+def parse_flow(lines: Iterable[str]) -> Dict[Tuple[int, int], int]:
+    """Parse `f src dst flow` response lines until `c EOI` into
+    {(src, dst): flow} (reference: readFlowGraph's dstToSrcAndFlow,
+    placement/solver.go:134-179 — keyed there as map[dst]map[src]; the
+    flat pair key is equivalent since DIMACS cannot express parallel
+    arcs). Comment lines other than the terminator are skipped, as the
+    reference skips the solver's `c ALGORITHM TIME` chatter
+    (solver.go:169-170). A repeated pair overwrites (last wins)."""
+    flows: Dict[Tuple[int, int], int] = {}
+    terminated = False
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("c"):
+            if line == "c EOI":
+                terminated = True
+                break
+            continue  # solver timing/debug chatter
+        parts = line.split()
+        if parts[0] != "f":
+            raise ValueError(f"unexpected line in flow response: {line!r}")
+        s, d, f = (int(x) for x in parts[1:4])
+        flows[(s, d)] = f
+    if not terminated:
+        # A dead solver / cut pipe must fail loudly, not decode as a
+        # partial assignment (the reference panics there, solver.go:178).
+        raise ValueError("flow response truncated: no 'c EOI' terminator")
+    return flows
+
+
+def flow_on_arcs(flows: Dict[Tuple[int, int], int], src, dst):
+    """Align a parsed {(src, dst): flow} response with a problem's arc
+    order: returns int64[num_arcs] with each arc's flow (0 when the
+    response omitted the arc). Feed the result to
+    solver.decode.flow_to_mapping for the task→PU assignment — the same
+    decode the in-process backends use, so an external solver's answer
+    is directly comparable."""
+    import numpy as np
+
+    out = np.zeros(len(src), np.int64)
+    for i, (s, d) in enumerate(zip(src, dst)):
+        out[i] = flows.get((int(s), int(d)), 0)
+    return out
 
 
 def parse_graph(lines: Iterable[str]):

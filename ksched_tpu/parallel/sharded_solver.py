@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..graph.device_export import FlowProblem
 from ..solver.base import FlowResult, FlowSolver
+from ..solver.layered import validate_alpha
 
 _BIG = jnp.int32(1 << 30)
 _BIG_D = 1 << 28
@@ -317,7 +318,7 @@ class ShardedJaxSolver(FlowSolver):
     def __init__(self, mesh: Mesh, axis: str = "x", alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True):
         self.mesh = mesh
         self.axis = axis
-        self.alpha = alpha
+        self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
         self._plan: Optional[ShardedPlan] = None

@@ -31,6 +31,7 @@ from ..solver.layered import (
     pad_geometry,
     solve_single_class,
     transport_fori,
+    validate_alpha,
 )
 
 
@@ -99,7 +100,7 @@ class WhatIfSolver:
         self.C = num_classes
         self.unsched_cost = int(unsched_cost)
         self.ec_cost = int(ec_cost)
-        self.alpha = alpha
+        self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.Mp, self.n_scale = pad_geometry(num_machines, num_classes)
 

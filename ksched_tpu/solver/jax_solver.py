@@ -274,7 +274,9 @@ class JaxSolver(FlowSolver):
     """Cost-scaling push-relabel on device, warm-started across rounds."""
 
     def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True):
-        self.alpha = alpha
+        from .layered import validate_alpha
+
+        self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
         self._prev: Optional[np.ndarray] = None  # previous round's flow

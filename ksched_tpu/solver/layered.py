@@ -109,8 +109,13 @@ def pad_geometry(num_machines: int, num_classes: int) -> Tuple[int, int]:
     return Mp, n_scale
 
 
-#: scaled costs must stay below 2^30 for int32 arithmetic headroom
-COST_SCALE_LIMIT = 1 << 30
+#: scaled costs must stay below 2^29 for int32 arithmetic headroom:
+#: with |wS| < 2^29 and pm clamped to ±2^28 (transport_tighten), the
+#: derived row prices satisfy |pr| <= 2^28 + 2^29, so any reduced cost
+#: rcf = wS + pr - pm is bounded by 2^29 + (2^28 + 2^29) + 2^28 =
+#: 1.5 * 2^30 < 2^31 - 1, wrap-free. (At 2^30 a worst-case pair of
+#: near-limit arcs of opposite sign could overflow the guard.)
+COST_SCALE_LIMIT = 1 << 29
 
 
 def default_eps0(n_scale: int) -> int:
@@ -487,13 +492,16 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
             np.int32(default_eps0(n_scale)),
             np.int32(max(1, max_w * n_scale)),
         ]
-        y = steps = None
+        y = None
         converged = False
+        # supersteps accumulate ACROSS attempts (matching the in-graph
+        # retry in transport_fori, which reports s1 + s2)
+        steps_taken = 0
         for eps_init in attempts:
             y, steps, converged = solve(wS, sup, cap, jnp.asarray(eps_init))
+            steps_taken += int(steps)
             if bool(converged):
                 break
-        steps_taken = int(steps)
         if not bool(converged):
             raise RuntimeError(
                 f"layered transport solve did not converge in "

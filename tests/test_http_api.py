@@ -108,3 +108,27 @@ def test_scheduler_service_end_to_end_over_http(server):
         assert server.pending_pods() == 0
     finally:
         api.close()
+
+
+def test_seen_pods_reconciled_and_recreated_pod_resurfaces(server):
+    """_seen_pods must track the pending listing (bounded, lock-guarded):
+    a bound pod is forgotten, and a pod later re-created with the same
+    name re-enters a batch instead of being filtered forever."""
+    api = HTTPClusterAPI(server.base_url, poll_interval_s=0.05)
+    try:
+        server.create_pods(1)  # pod_0
+        batch = api.get_pod_batch(timeout_s=0.5)
+        assert [p.pod_id for p in batch] == ["pod_0"]
+        api.assign_bindings([Binding("pod_0", "node_x")])
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline and api._seen_pods:
+            time.sleep(0.02)
+        assert not api._seen_pods  # reconciled away once off the listing
+        # simulate delete + re-create with the same name: the binding
+        # disappears server-side and the pod is pending again
+        with server._state.lock:
+            server._state.bindings.pop("pod_0")
+        batch = api.get_pod_batch(timeout_s=0.5)
+        assert [p.pod_id for p in batch] == ["pod_0"]  # re-surfaced
+    finally:
+        api.close()

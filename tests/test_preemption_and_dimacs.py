@@ -221,3 +221,68 @@ def test_job_completion_removes_unsched_aggregator():
     assert not sched.gm.job_unsched_to_node
     # supply conservation after full teardown
     assert sched.gm.sink_node.excess == -len(sched.gm.task_to_node) == 0
+
+
+# ---------------------------------------------------------------------------
+# solver flow-response codec (the loop back from an external solver)
+# ---------------------------------------------------------------------------
+
+
+def test_flow_response_round_trip_matches_in_process_decode():
+    """export_flow -> parse_flow -> flow_on_arcs -> flow_to_mapping must
+    reproduce the in-process decode exactly, closing the DIMACS loop so
+    an external solver (e.g. real Flowlessly) can serve as a parity
+    oracle (response format: placement/solver.go:134-179)."""
+    from ksched_tpu.graph.dimacs import export_flow, flow_on_arcs, parse_flow
+    from ksched_tpu.solver.decode import flow_to_mapping
+
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=2, pus_per_core=2)
+    add_job(sched, jmap, tmap, num_tasks=3)
+    n, _ = sched.schedule_all_jobs()
+    assert n == 3
+
+    ps = sched.solver
+    problem = ps.state.problem()
+    result = ps.backend.solve(problem)
+    tf = result.total_flow(problem)
+    assert tf.sum() > 0
+    task_ids = [node.id for node in sched.gm.task_to_node.values()]
+    direct = flow_to_mapping(
+        problem, tf, sched.gm.leaf_node_ids, sched.gm.sink_node.id, task_ids
+    )
+    assert direct  # placements exist
+
+    buf = io.StringIO()
+    export_flow(problem.src, problem.dst, tf, buf)
+    text = buf.getvalue()
+    assert text.endswith("c EOI\n")
+    # prepend the solver's timing chatter the reference skips
+    # (solver.go:169-170) and trailing garbage the EOI framing must hide
+    wire = "c ALGORITHM successive_shortest_path 12ms\n" + text + "f 9 9 9\n"
+    flows = parse_flow(io.StringIO(wire))
+    assert (9, 9) not in flows  # post-EOI lines belong to the next round
+    tf2 = flow_on_arcs(flows, problem.src, problem.dst)
+    assert (tf2 == tf).all()
+    external = flow_to_mapping(
+        problem, tf2, sched.gm.leaf_node_ids, sched.gm.sink_node.id, task_ids
+    )
+    assert external == direct
+
+
+def test_parse_flow_last_pair_wins_and_rejects_junk():
+    from ksched_tpu.graph.dimacs import parse_flow
+
+    flows = parse_flow(io.StringIO("f 1 2 3\nf 1 2 5\nc EOI\n"))
+    assert flows == {(1, 2): 5}
+    try:
+        parse_flow(io.StringIO("q nonsense\n"))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("junk line must raise")
+    try:
+        parse_flow(io.StringIO("f 1 2 3\n"))  # dead solver / cut pipe
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("truncated response (no c EOI) must raise")
