@@ -71,6 +71,7 @@ class BulkCluster:
         class_cost_fn: Optional[Callable[["BulkCluster"], np.ndarray]] = None,
         num_task_classes: int = 1,
         task_capacity: int = 2_048,
+        job_unsched_cost: Optional[np.ndarray] = None,
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -80,6 +81,15 @@ class BulkCluster:
         self.backend = backend
         self.unsched_cost = unsched_cost
         self.ec_cost = ec_cost
+        # Per-job unsched-arc costs (the reference's per-job unsched
+        # aggregators each carry their own cost, graph_manager.go:
+        # 1291-1305 + interface.go TaskToUnscheduledAggCost). None =
+        # every job at the scalar unsched_cost.
+        from ..solver.layered import validate_job_unsched_cost
+
+        self.job_unsched_cost = validate_job_unsched_cost(
+            job_unsched_cost, num_jobs
+        )
         self.machine_cost_fn = machine_cost_fn
         self.class_cost_fn = class_cost_fn
 
@@ -230,7 +240,10 @@ class BulkCluster:
         # task's OWN class EC.
         a0 = self.a_task0 + self.arcs_per_task * rows
         self.cap[a0] = 1
-        self.cost[a0] = self.unsched_cost
+        if self.job_unsched_cost is not None:
+            self.cost[a0] = self.job_unsched_cost[job_ids]
+        else:
+            self.cost[a0] = self.unsched_cost
         a_cls = a0 + 1 + classes
         self.cap[a_cls] = 1
         self.cost[a_cls] = self.ec_cost
@@ -418,15 +431,36 @@ class BulkCluster:
         machine_free = pu_free.reshape(M, self.P).sum(axis=1)
         unplaced = np.nonzero(self.task_live & (self.task_pu < 0))[0]
         cls = self.task_class[unplaced]
-        supply = np.bincount(cls, minlength=C).astype(np.int32)
         cost_cm = self.cost[self.a_ecm0 : self.a_ecm0 + C * M].reshape(C, M)
-        lp = LayeredProblem(
-            supply=supply,
-            col_cap=machine_free.astype(np.int32),
-            cost_cm=cost_cm,
-            unsched_cost=self.unsched_cost,
-            ec_cost=self.ec_cost,
-        )
+        if self.job_unsched_cost is not None:
+            # Per-job unsched costs make (job, class) pairs distinct
+            # commodities: expand the row axis to G = J*C groups, row
+            # g = j*C + c carrying class c's cost row and job j's
+            # escape cost. The collapse stays exact — tasks within a
+            # group are still interchangeable.
+            grp = self.task_job[unplaced] * C + cls
+            G = self.J * C
+            supply = np.bincount(grp, minlength=G).astype(np.int32)
+            lp = LayeredProblem(
+                supply=supply,
+                col_cap=machine_free.astype(np.int32),
+                cost_cm=np.tile(cost_cm, (self.J, 1)),
+                unsched_cost=self.unsched_cost,
+                ec_cost=self.ec_cost,
+                row_unsched_cost=np.repeat(self.job_unsched_cost, C),
+            )
+            row_of_task = grp
+        else:
+            G = C
+            supply = np.bincount(cls, minlength=C).astype(np.int32)
+            lp = LayeredProblem(
+                supply=supply,
+                col_cap=machine_free.astype(np.int32),
+                cost_cm=cost_cm,
+                unsched_cost=self.unsched_cost,
+                ec_cost=self.ec_cost,
+            )
+            row_of_task = cls
         timing["stats_s"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -434,23 +468,26 @@ class BulkCluster:
         timing["solve_s"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        y = res.y  # int64[C, M]
-        placed_per_class = y.sum(axis=1)
-        # Stage 1 — pick which tasks place (any within-class choice is
+        y = res.y  # int64[G, M]
+        placed_per_row = y.sum(axis=1)
+        # Stage 1 — pick which tasks place (any within-row choice is
         # cost-identical) and pair them rank-for-rank with the machine
-        # grants, machine-major per class.
-        placed_rows = np.empty(int(placed_per_class.sum()), dtype=np.int64)
-        machine_of_task = np.empty(len(placed_rows), dtype=np.int64)
-        off = 0
-        for c in range(C):
-            k = int(placed_per_class[c])
-            if not k:
-                continue
-            placed_rows[off : off + k] = unplaced[cls == c][:k]
-            machine_of_task[off : off + k] = np.repeat(
-                np.arange(M, dtype=np.int64), y[c]
-            )
-            off += k
+        # grants, machine-major per row. One stable argsort groups the
+        # unplaced tasks row-major (row order preserved within a row),
+        # so each row's first placed_per_row[g] entries pair with that
+        # row's grants — O(n log n), no per-group rescans.
+        order = np.argsort(row_of_task, kind="stable")
+        counts = np.bincount(row_of_task, minlength=G)
+        starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        sorted_grp = row_of_task[order]
+        rank_in_row = np.arange(len(order), dtype=np.int64) - starts[sorted_grp]
+        take = rank_in_row < placed_per_row[sorted_grp]
+        placed_rows = unplaced[order[take]]
+        # grants expanded row-major then machine-major — the same order
+        # as placed_rows after the argsort
+        machine_of_task = np.repeat(
+            np.tile(np.arange(M, dtype=np.int64), G), y.reshape(-1)
+        )
         # Stage 2 — split each machine's grant across its PUs in slot
         # order, then pair with tasks sorted (stably) by machine.
         t_m = y.sum(axis=0)

@@ -44,6 +44,7 @@ from ..solver.layered import (
     pad_geometry,
     transport_fori,
     validate_alpha,
+    validate_job_unsched_cost,
 )
 
 
@@ -73,6 +74,7 @@ class DeviceBulkCluster:
         supersteps: Optional[int] = None,
         decode_width: Optional[int] = None,  # steady-round decode window
         alpha: int = 8,  # eps-schedule divisor for iterative solves
+        job_unsched_cost: Optional[np.ndarray] = None,
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -85,6 +87,20 @@ class DeviceBulkCluster:
         self.ec_cost = int(ec_cost)
         self.class_cost_fn = class_cost_fn
         self.alpha = validate_alpha(alpha)
+        # Per-job unsched costs (graph_manager.go:1291-1305: each job's
+        # unsched aggregator has its own cost). When set, (job, class)
+        # pairs become distinct transport commodities: the solve's row
+        # axis expands from C classes to G = J*C groups, g = j*C + c.
+        # Intended for moderate J (tens to low hundreds): the dense
+        # transport carries [G, M] state and the decode a [W, G]
+        # one-hot, both linear in G — at thousands of jobs the CSR
+        # graph path (per-task unsched arcs) is the right tool.
+        self.job_unsched_cost = validate_job_unsched_cost(
+            job_unsched_cost, num_jobs
+        )
+        job_unsched_cost = self.job_unsched_cost  # normalized array/None
+        self.per_job = job_unsched_cost is not None
+        self.G = num_jobs * num_task_classes if self.per_job else num_task_classes
         if decode_width is not None:
             if decode_width <= 0:
                 raise ValueError(
@@ -93,16 +109,24 @@ class DeviceBulkCluster:
             if decode_width >= task_capacity:
                 decode_width = None  # wider than the pool = the full path
         self.decode_width = None if decode_width is None else int(decode_width)
-        # C == 1 uses the exact closed form (no iterations); C >= 2 runs
-        # the cost-scaling schedule under a lax.while_loop that exits on
-        # convergence — this is only the safety bound, not the cost.
+        # Degenerate = every group shares one cost row (no class cost
+        # model, and no per-job cost spread): the solve collapses to
+        # the exact closed form regardless of G.
+        self.class_degenerate = class_cost_fn is None and (
+            job_unsched_cost is None
+            or bool((job_unsched_cost == job_unsched_cost[0]).all())
+        )
+        # Closed-form solves (G == 1 or degenerate) take no iterations;
+        # otherwise the cost-scaling schedule runs under a
+        # lax.while_loop that exits on convergence — this is only the
+        # safety bound, not the cost.
         self.supersteps = int(
             supersteps if supersteps is not None
-            else (1 if num_task_classes == 1 else 16384)
+            else (1 if (self.G == 1 or self.class_degenerate) else 16384)
         )
 
         # Padded transport columns: [machines | zero-cap padding | unsched]
-        self.Mp, self.n_scale = pad_geometry(num_machines, num_task_classes)
+        self.Mp, self.n_scale = pad_geometry(num_machines, self.G)
 
         self.state = DeviceClusterState(
             live=jnp.zeros(self.Tcap, jnp.bool_),
@@ -130,6 +154,16 @@ class DeviceBulkCluster:
         alpha = self.alpha
         steady_decode_width = self.decode_width
         i32 = jnp.int32
+        per_job, Gn = self.per_job, self.G
+        class_degenerate = self.class_degenerate
+        # Per-row (group) escape costs: row g = j*C + c escapes at job
+        # j's unsched cost; without per-job costs every row uses the
+        # scalar. Closure constant — baked into the compiled round.
+        u_row = jnp.asarray(
+            np.repeat(self.job_unsched_cost, C).astype(np.int32)
+            if per_job
+            else np.full(Gn, u_cost, np.int32)
+        )
 
         def census_of(state: DeviceClusterState):
             """Per-machine running-class census [M, C] (the vectorized
@@ -168,6 +202,7 @@ class DeviceBulkCluster:
                 idx = None  # identity window
                 valid = unplaced
                 cls_w = state.cls
+                job_w = state.job
             else:
                 W = int(decode_width)
                 # compact W unplaced rows into the window: select the
@@ -193,16 +228,23 @@ class DeviceBulkCluster:
                 cls_w = jnp.where(
                     valid, state.cls[jnp.clip(idx, 0, Tcap - 1)], i32(C)
                 )
-            supply = jnp.stack(
-                [jnp.sum((cls_w == c) & valid, dtype=i32) for c in range(C)]
-            )
+                job_w = jnp.where(
+                    valid, state.job[jnp.clip(idx, 0, Tcap - 1)], i32(0)
+                )
+            # group index per window row; sentinel Gn for invalid rows
+            g_w = (job_w * i32(C) + cls_w) if per_job else cls_w
+            g_safe = jnp.where(valid, g_w, i32(Gn))
+            supply = jnp.zeros(Gn + 1, i32).at[g_safe].add(1)[:Gn]
             total = jnp.sum(supply)
 
             if cost_fn is not None:
                 cost_cm = cost_fn(census_of(state)).astype(i32)
             else:
                 cost_cm = jnp.zeros((C, M), i32)
-            w = cost_cm + i32(e_cost) - i32(u_cost)
+            # group rows: g = j*C + c carries class c's cost row and
+            # job j's escape cost (the per-job unsched differentiation)
+            cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
+            w = cost_gm + i32(e_cost) - u_row[:, None]
             # int32 headroom guard: the host solver raises OverflowError
             # for the same condition (solver/layered.py solve_layered);
             # in a jitted round we can only flag it — surfaced in stats
@@ -211,7 +253,7 @@ class DeviceBulkCluster:
                 COST_SCALE_LIMIT // n_scale
             )
 
-            wS = jnp.zeros((C, Mp), i32).at[:, :M].set(w * i32(n_scale))
+            wS = jnp.zeros((Gn, Mp), i32).at[:, :M].set(w * i32(n_scale))
             col_cap = (
                 jnp.zeros(Mp, i32).at[:M].set(machine_free).at[Mp - 1].set(total)
             )
@@ -235,7 +277,7 @@ class DeviceBulkCluster:
                 wS, supply, col_cap, supersteps,
                 alpha=alpha,
                 eps0=default_eps0(n_scale),
-                class_degenerate=cost_fn is None,
+                class_degenerate=class_degenerate,
             )
             y_real = y[:, :M]
 
@@ -250,34 +292,34 @@ class DeviceBulkCluster:
             exclg = jnp.cumsum(pf2, axis=1) - pf2
             grants = jnp.clip(t_m[:, None] - exclg, 0, pf2)
             cumg = jnp.cumsum(grants, axis=1).astype(jnp.float32)  # [M, P]
-            # exclusive per-class offsets into each machine's grant slots
-            offs = jnp.cumsum(y_real, axis=0) - y_real  # [C, M]
+            # exclusive per-group offsets into each machine's grant slots
+            offs = jnp.cumsum(y_real, axis=0) - y_real  # [Gn, M]
 
             cols = jnp.arange(M, dtype=i32)[None, :]
-            # per-class ranks among the window's valid rows ([W]-sized);
-            # classes partition tasks, so a masked sum merges them
-            rank = jnp.zeros(W, i32)
-            placed_w = jnp.zeros(W, jnp.bool_)
-            for c in range(C):
-                mask_c = valid & (cls_w == c)
-                r = jnp.cumsum(mask_c.astype(i32)) - 1
-                rank = jnp.where(mask_c, r, rank)
-                placed_w = placed_w | (mask_c & (r < jnp.sum(y_real[c])))
-
-            onehot = (
-                (cls_w[:, None] == jnp.arange(C, dtype=i32)[None, :])
-                & valid[:, None]
-            ).astype(jnp.float32)  # [W, C]
             # precision=HIGHEST: TPU f32 matmuls default to bf16 passes,
             # whose 8-bit mantissa corrupts counts beyond 256 — these
-            # gathers carry cumulative grant counts up to Tcap.
+            # gathers carry cumulative grant counts up to Tcap. (All
+            # counts here are < 2^24, so f32 at HIGHEST is exact.)
             hi = jax.lax.Precision.HIGHEST
-            cum_all = jnp.cumsum(y_real, axis=1).astype(jnp.float32)  # [C, M]
+            # per-group ranks among the window's valid rows, via one
+            # [W, Gn] one-hot cumsum (groups partition tasks; the
+            # sentinel row Gn of invalid entries hits no column)
+            onehot = (
+                g_safe[:, None] == jnp.arange(Gn, dtype=i32)[None, :]
+            ).astype(jnp.float32)  # [W, Gn]
+            cum_oh = jnp.cumsum(onehot, axis=0)
+            rank_f = jnp.sum((cum_oh - onehot) * onehot, axis=1)  # excl rank
+            quota = jnp.einsum(
+                "tg,g->t", onehot,
+                jnp.sum(y_real, axis=1).astype(jnp.float32), precision=hi,
+            )
+            placed_w = valid & (rank_f < quota)
+
+            cum_all = jnp.cumsum(y_real, axis=1).astype(jnp.float32)  # [Gn, M]
             cum_sel = jnp.einsum("tc,cm->tm", onehot, cum_all, precision=hi)
             off_sel = jnp.einsum(
                 "tc,cm->tm", onehot, offs.astype(jnp.float32), precision=hi
             )
-            rank_f = rank.astype(jnp.float32)
             cmp = cum_sel <= rank_f[:, None]  # [W, M]
             machine = jnp.sum(cmp, axis=1, dtype=i32)  # grant machine
             excl_at = jnp.max(jnp.where(cmp, cum_sel, 0.0), axis=1)
@@ -309,9 +351,20 @@ class DeviceBulkCluster:
             # unscheduled counts the WHOLE backlog left pending (solver
             # escapes + rows beyond the decode window) — matches the
             # host BulkCluster's num_unsched accounting
-            objective = i32(u_cost) * (backlog - placed_count) + jnp.sum(
-                (cost_cm + i32(e_cost)) * y_real
-            )
+            if per_job:
+                # per-group escape pricing needs the whole-pool backlog
+                # split by group, not just the window's
+                g_all = state.job * i32(C) + state.cls
+                g_all_safe = jnp.where(unplaced, g_all, i32(Gn))
+                backlog_g = jnp.zeros(Gn + 1, i32).at[g_all_safe].add(1)[:Gn]
+                placed_g = jnp.sum(y_real, axis=1).astype(i32)
+                objective = jnp.sum(u_row * (backlog_g - placed_g)) + jnp.sum(
+                    (cost_gm + i32(e_cost)) * y_real
+                )
+            else:
+                objective = i32(u_cost) * (backlog - placed_count) + jnp.sum(
+                    (cost_cm + i32(e_cost)) * y_real
+                )
             stats = {
                 "placed": placed_count,
                 "unscheduled": backlog - placed_count,

@@ -59,6 +59,20 @@ _BIG = np.int32(BIG)
 _BIG_D = np.int32(BIG_D)
 
 
+def validate_job_unsched_cost(job_unsched_cost, num_jobs: int):
+    """Normalize/validate the per-job unsched-cost knob (None passes
+    through). One definition shared by BulkCluster, DeviceBulkCluster,
+    and tests so the three call sites cannot drift."""
+    if job_unsched_cost is None:
+        return None
+    out = np.asarray(job_unsched_cost, np.int64)
+    if out.shape != (num_jobs,):
+        raise ValueError(
+            f"job_unsched_cost must have shape ({num_jobs},), got {out.shape}"
+        )
+    return out
+
+
 def validate_alpha(alpha: int) -> int:
     """alpha < 2 would make the eps phase schedule a fixed point and
     hang the solve loop; one guard shared by every constructor that
@@ -71,13 +85,22 @@ def validate_alpha(alpha: int) -> int:
 
 @dataclass
 class LayeredProblem:
-    """The aggregate scheduling round, in class-by-machine form."""
+    """The aggregate scheduling round, in row-by-machine form. A row is
+    a commodity of interchangeable tasks: a task class in the basic
+    shape, or a (job, class) group when per-job unscheduled costs
+    differentiate jobs (the reference's per-job unsched aggregators,
+    graph_manager.go:1291-1305 — each job's escape arc has its own
+    cost, so tasks of one class but different jobs are distinct
+    commodities)."""
 
-    supply: np.ndarray  # int32[C] unplaced live tasks per class
+    supply: np.ndarray  # int32[C] unplaced live tasks per row
     col_cap: np.ndarray  # int32[M] free slots per machine
-    cost_cm: np.ndarray  # int32[C, M] EC->machine arc cost per class
+    cost_cm: np.ndarray  # int32[C, M] EC->machine arc cost per row
     unsched_cost: int  # u: task->unsched arc cost
     ec_cost: int  # e: task->EC arc cost
+    #: optional per-row unsched costs overriding the scalar (int[C]);
+    #: row r's escape then costs row_unsched_cost[r]
+    row_unsched_cost: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -459,8 +482,14 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
             y=np.zeros((C, M), np.int64), num_unsched=0, objective=0, supersteps=0
         )
     # Shifted per-unit cost: placing costs (e + cost[c,m]), leaving
-    # unscheduled costs u; subtract u so the unsched column is 0.
-    w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - int(lp.unsched_cost)
+    # unscheduled costs u (per row when row_unsched_cost is set);
+    # subtract u so the unsched column is 0 for every row.
+    if lp.row_unsched_cost is not None:
+        u_row = np.asarray(lp.row_unsched_cost, np.int64)
+        assert u_row.shape == (C,), f"row_unsched_cost must be [{C}]"
+    else:
+        u_row = np.full(C, int(lp.unsched_cost), np.int64)
+    w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - u_row[:, None]
     Mp, n_scale = pad(M, C)
     wP = np.zeros((C, Mp), np.int64)
     wP[:, :M] = w
@@ -510,7 +539,8 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
         y_np = np.asarray(y).astype(np.int64)
     y_real = y_np[:, :M]
     placed = int(y_real.sum())
-    objective = int(lp.unsched_cost) * (total - placed) + int(
+    unplaced_row = supply - y_real.sum(axis=1)
+    objective = int((u_row * unplaced_row).sum()) + int(
         ((lp.cost_cm.astype(np.int64) + int(lp.ec_cost)) * y_real).sum()
     )
     return LayeredResult(

@@ -150,3 +150,102 @@ def test_device_cost_overflow_flagged():
     dev.add_tasks(4, classes=np.array([0, 1, 0, 1], np.int32))
     with pytest.raises(OverflowError):
         dev.fetch_stats(dev.round())
+
+
+# ---------------------------------------------------------------------------
+# per-job unscheduled aggregation (graph_manager.go:1291-1305)
+# ---------------------------------------------------------------------------
+
+
+def _per_job_graph_path_counts(u_a: int, u_b: int):
+    """Host graph-path oracle: 2 machines x 1 PU x 1 slot, two 2-task
+    jobs with unsched costs (u_a, u_b). Returns placed count per job."""
+    from ksched_tpu.costmodels.trivial import TrivialCostModel
+    from ksched_tpu.drivers import add_job, build_cluster
+
+    costs = {}
+
+    class PerJobUnschedModel(TrivialCostModel):
+        def task_to_unscheduled_agg_cost(self, task_id):
+            return costs.get(self.task_map.find(task_id).job_id, self.UNSCHEDULED_COST)
+
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=2, pus_per_core=1, cost_model_factory=PerJobUnschedModel
+    )
+    jid_a = add_job(sched, jmap, tmap, num_tasks=2)
+    jid_b = add_job(sched, jmap, tmap, num_tasks=2)
+    costs[str(jid_a)] = u_a
+    costs[str(jid_b)] = u_b
+    sched.schedule_all_jobs()
+    placed = {str(jid_a): 0, str(jid_b): 0}
+    for tid in sched.task_bindings:
+        placed[tmap.find(tid).job_id] += 1
+    return placed[str(jid_a)], placed[str(jid_b)]
+
+
+def test_per_job_unsched_device_matches_graph_path():
+    """Jobs become distinct commodities when their unsched (escape)
+    costs differ: a job whose escape is cheaper than placing stays
+    unscheduled while a dear-escape job fills the slots. The device
+    path must reproduce the host graph path's per-job placement counts
+    (tasks within a job/class are interchangeable, so counts are the
+    right equivalence)."""
+    # u=1 < EC cost 2: strictly cheaper to stay; u=10: strictly places.
+    graph_counts = _per_job_graph_path_counts(1, 10)
+    assert graph_counts == (0, 2)
+
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=1, num_jobs=2,
+        task_capacity=16, job_unsched_cost=np.array([1, 10]),
+    )
+    dev.add_tasks(4, np.array([0, 0, 1, 1], np.int32))
+    stats = dev.fetch_stats(dev.round())
+    assert bool(stats["converged"])
+    st = {k: np.asarray(v) for k, v in dev.fetch_state().items()}
+    rows = np.nonzero(st["live"] & (st["pu"] >= 0))[0]
+    dev_counts = (
+        int((st["job"][rows] == 0).sum()),
+        int((st["job"][rows] == 1).sum()),
+    )
+    assert dev_counts == graph_counts
+    # objective: 2 job-0 escapes at u=1 + 2 placements at e=2
+    assert int(stats["objective"]) == 2 * 1 + 2 * 2
+    assert int(stats["unscheduled"]) == 2
+
+
+def test_per_job_unsched_host_bulk_layered_matches_csr():
+    """BulkCluster's layered fast path (group-expanded rows) and the
+    generic CSR path (per-job arc costs) must agree on per-job
+    placements and unscheduled counts."""
+    from ksched_tpu.solver.cpu_ref import ReferenceSolver
+
+    u = np.array([1, 10])
+    outs = []
+    for backend in (LayeredTransportSolver(), ReferenceSolver()):
+        cl = BulkCluster(
+            num_machines=2, pus_per_machine=1, slots_per_pu=1, num_jobs=2,
+            backend=backend, job_unsched_cost=u, task_capacity=16,
+        )
+        cl.add_tasks(4, np.array([0, 0, 1, 1], np.int32))
+        r = cl.round()
+        rows = r.placed_tasks - cl.task0
+        outs.append(
+            (sorted(cl.task_job[rows].tolist()), r.num_unscheduled)
+        )
+    assert outs[0] == outs[1] == ([1, 1], 2)
+
+
+def test_per_job_unsched_equal_costs_stays_degenerate():
+    """Equal per-job costs must collapse to the closed form (no
+    iterations) — the group expansion alone must not force the
+    iterative solve."""
+    dev = DeviceBulkCluster(
+        num_machines=4, pus_per_machine=1, slots_per_pu=2, num_jobs=3,
+        task_capacity=32, job_unsched_cost=np.array([5, 5, 5]),
+    )
+    assert dev.class_degenerate and dev.supersteps == 1
+    dev.add_tasks(6, np.array([0, 1, 2, 0, 1, 2], np.int32))
+    stats = dev.fetch_stats(dev.round())
+    assert bool(stats["converged"])
+    assert int(stats["supersteps"]) == 0  # closed form, no iterations
+    assert int(stats["placed"]) == 6
