@@ -43,6 +43,7 @@ from ..solver.layered import (
     default_eps0,
     pad_geometry,
     transport_fori,
+    transport_fori_tiered,
     validate_alpha,
     validate_job_unsched_cost,
 )
@@ -75,6 +76,8 @@ class DeviceBulkCluster:
         decode_width: Optional[int] = None,  # steady-round decode window
         alpha: int = 8,  # eps-schedule divisor for iterative solves
         job_unsched_cost: Optional[np.ndarray] = None,
+        preemption: bool = False,
+        continuation_discount: int = 1,
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -101,6 +104,25 @@ class DeviceBulkCluster:
         job_unsched_cost = self.job_unsched_cost  # normalized array/None
         self.per_job = job_unsched_cost is not None
         self.G = num_jobs * num_task_classes if self.per_job else num_task_classes
+        # Preemption (keep-arcs semantics, graph_manager.go:855-888):
+        # every round's solve reconsiders PLACED tasks too — staying on
+        # the current machine is discounted by `continuation_discount`
+        # (the aggregate TaskContinuationCost, interface.go:75-79),
+        # moving pays full price, escaping pays the unsched cost (the
+        # aggregate TaskPreemptionCost). Machine capacity counts total
+        # slots, not free ones (the :662-667 rule flips). The round
+        # emits PLACE / MIGRATE / PREEMPT counts; the solve is the
+        # tiered transport (solver/layered.py transport_fori_tiered).
+        self.preemption = bool(preemption)
+        self.continuation_discount = int(continuation_discount)
+        if self.preemption:
+            if continuation_discount < 0:
+                raise ValueError("continuation_discount must be >= 0")
+            if decode_width is not None:
+                raise ValueError(
+                    "preemption mode decodes the full task pool: "
+                    "decode_width is not supported"
+                )
         if decode_width is not None:
             if decode_width <= 0:
                 raise ValueError(
@@ -116,6 +138,12 @@ class DeviceBulkCluster:
             job_unsched_cost is None
             or bool((job_unsched_cost == job_unsched_cost[0]).all())
         )
+        # A positive continuation discount makes cells residency-
+        # dependent, so the degenerate collapse only applies to
+        # preemption mode at discount 0 (where the tiers coincide and
+        # the ordinary solve serves).
+        if self.preemption and self.continuation_discount > 0:
+            self.class_degenerate = False
         # Closed-form solves (G == 1 or degenerate) take no iterations;
         # otherwise the cost-scaling schedule runs under a
         # lax.while_loop that exits on convergence — this is only the
@@ -156,6 +184,7 @@ class DeviceBulkCluster:
         i32 = jnp.int32
         per_job, Gn = self.per_job, self.G
         class_degenerate = self.class_degenerate
+        preempt, discount = self.preemption, self.continuation_discount
         # Per-row (group) escape costs: row g = j*C + c escapes at job
         # j's unsched cost; without per-job costs every row uses the
         # scalar. Closure constant — baked into the compiled round.
@@ -173,6 +202,63 @@ class DeviceBulkCluster:
             idx = jnp.where(placed, machine * C + state.cls, M * C)
             flat = jnp.zeros(M * C + 1, i32).at[idx].add(1)
             return flat[: M * C].reshape(M, C)
+
+        def rank_match_decode(g_safe, grants_gm, pu_free):
+            """Rank-match participant rows to machine grants — the
+            shared decode of both round flavors. g_safe [W] holds each
+            row's group (sentinel Gn = not participating), grants_gm
+            [Gn, M] the solver's per-group machine grants, pu_free
+            [num_pus] the slots these grants may occupy. Returns
+            (granted bool[W], pu_abs i32[W]).
+
+            Each group's cumulative-grant row is gathered per task via
+            a one-hot [W, Gn] x [Gn, M] matmul (MXU), and in-group
+            ranks come from one one-hot cumsum — no per-group Python
+            loop. precision=HIGHEST throughout: TPU f32 matmuls default
+            to bf16 passes, whose 8-bit mantissa corrupts counts beyond
+            256; all counts here are < 2^24, so f32 at HIGHEST is
+            exact."""
+            W = g_safe.shape[0]
+            hi = jax.lax.Precision.HIGHEST
+            part = g_safe < i32(Gn)
+            onehot = (
+                g_safe[:, None] == jnp.arange(Gn, dtype=i32)[None, :]
+            ).astype(jnp.float32)  # [W, Gn]; sentinel rows hit no column
+            cum_oh = jnp.cumsum(onehot, axis=0)
+            rank_f = jnp.sum((cum_oh - onehot) * onehot, axis=1)  # excl rank
+            quota = jnp.einsum(
+                "tg,g->t", onehot,
+                jnp.sum(grants_gm, axis=1).astype(jnp.float32), precision=hi,
+            )
+            granted = part & (rank_f < quota)
+
+            # group-row -> machine via cumulative-grant comparisons
+            offs = jnp.cumsum(grants_gm, axis=0) - grants_gm  # [Gn, M]
+            cum_all = jnp.cumsum(grants_gm, axis=1).astype(jnp.float32)
+            cum_sel = jnp.einsum("tc,cm->tm", onehot, cum_all, precision=hi)
+            off_sel = jnp.einsum(
+                "tc,cm->tm", onehot, offs.astype(jnp.float32), precision=hi
+            )
+            cols = jnp.arange(M, dtype=i32)[None, :]
+            cmp = cum_sel <= rank_f[:, None]  # [W, M]
+            machine = jnp.sum(cmp, axis=1, dtype=i32)  # grant machine
+            excl_at = jnp.max(jnp.where(cmp, cum_sel, 0.0), axis=1)
+            oh = machine[:, None] == cols  # [W, M]
+            off_at = jnp.sum(jnp.where(oh, off_sel, 0.0), axis=1)
+            slot = off_at + (rank_f - excl_at)  # within-machine slot
+
+            # split each machine's grant across its PUs in slot order
+            t_m = jnp.sum(grants_gm, axis=0)
+            pf2 = pu_free.reshape(M, P)
+            exclg = jnp.cumsum(pf2, axis=1) - pf2
+            grants_pu = jnp.clip(t_m[:, None] - exclg, 0, pf2)
+            cumg = jnp.cumsum(grants_pu, axis=1).astype(jnp.float32)
+            cg_at = jnp.einsum(
+                "tm,mp->tp", oh.astype(jnp.float32), cumg, precision=hi
+            )  # [W, P]
+            pu_in = jnp.sum(cg_at <= slot[:, None], axis=1)
+            pu_abs = machine * P + pu_in.astype(i32)
+            return granted, pu_abs
 
         def round_core(state: DeviceClusterState, decode_width=None,
                        window_offset=None):
@@ -282,55 +368,7 @@ class DeviceBulkCluster:
             y_real = y[:, :M]
 
             # ---- decode: rank-match placed tasks to machine grants ----
-            # One class-gathered pass instead of a per-class loop: each
-            # class's cumulative-grant row is gathered per task via a
-            # one-hot [Tcap, C] x [C, M] matmul (MXU; counts < 2^24 so
-            # f32 accumulation is exact), cutting the number of
-            # [Tcap, M]-sized VPU passes from ~12*C to ~5.
-            t_m = jnp.sum(y_real, axis=0)
-            pf2 = pu_free.reshape(M, P)
-            exclg = jnp.cumsum(pf2, axis=1) - pf2
-            grants = jnp.clip(t_m[:, None] - exclg, 0, pf2)
-            cumg = jnp.cumsum(grants, axis=1).astype(jnp.float32)  # [M, P]
-            # exclusive per-group offsets into each machine's grant slots
-            offs = jnp.cumsum(y_real, axis=0) - y_real  # [Gn, M]
-
-            cols = jnp.arange(M, dtype=i32)[None, :]
-            # precision=HIGHEST: TPU f32 matmuls default to bf16 passes,
-            # whose 8-bit mantissa corrupts counts beyond 256 — these
-            # gathers carry cumulative grant counts up to Tcap. (All
-            # counts here are < 2^24, so f32 at HIGHEST is exact.)
-            hi = jax.lax.Precision.HIGHEST
-            # per-group ranks among the window's valid rows, via one
-            # [W, Gn] one-hot cumsum (groups partition tasks; the
-            # sentinel row Gn of invalid entries hits no column)
-            onehot = (
-                g_safe[:, None] == jnp.arange(Gn, dtype=i32)[None, :]
-            ).astype(jnp.float32)  # [W, Gn]
-            cum_oh = jnp.cumsum(onehot, axis=0)
-            rank_f = jnp.sum((cum_oh - onehot) * onehot, axis=1)  # excl rank
-            quota = jnp.einsum(
-                "tg,g->t", onehot,
-                jnp.sum(y_real, axis=1).astype(jnp.float32), precision=hi,
-            )
-            placed_w = valid & (rank_f < quota)
-
-            cum_all = jnp.cumsum(y_real, axis=1).astype(jnp.float32)  # [Gn, M]
-            cum_sel = jnp.einsum("tc,cm->tm", onehot, cum_all, precision=hi)
-            off_sel = jnp.einsum(
-                "tc,cm->tm", onehot, offs.astype(jnp.float32), precision=hi
-            )
-            cmp = cum_sel <= rank_f[:, None]  # [W, M]
-            machine = jnp.sum(cmp, axis=1, dtype=i32)  # grant machine
-            excl_at = jnp.max(jnp.where(cmp, cum_sel, 0.0), axis=1)
-            oh = machine[:, None] == cols  # [W, M]
-            off_at = jnp.sum(jnp.where(oh, off_sel, 0.0), axis=1)
-            slot = off_at + (rank_f - excl_at)  # within-machine slot
-            cg_at = jnp.einsum(
-                "tm,mp->tp", oh.astype(jnp.float32), cumg, precision=hi
-            )  # [W, P]; counts < 2^24, exact in f32 at HIGHEST
-            pu_in = jnp.sum(cg_at <= slot[:, None], axis=1)
-            pu_abs = machine * P + pu_in.astype(i32)
+            placed_w, pu_abs = rank_match_decode(g_safe, y_real, pu_free)
 
             if idx is None:
                 # identity window: elementwise select, no scatter
@@ -375,6 +413,120 @@ class DeviceBulkCluster:
                 # solver supersteps this round (0 on closed-form paths)
                 # — the observability the reference parses and discards
                 # (placement/solver.go:169-170)
+                "supersteps": solve_steps,
+            }
+            return state._replace(pu=new_pu, pu_running=pu_running), stats
+
+        def round_core_preempt(state: DeviceClusterState):
+            """Preemption-on round (keep-arcs semantics, graph_manager.
+            go:855-888): every live task re-solves. Staying on the
+            current machine is discounted, moving pays full price,
+            escaping pays the group's unsched cost; machine capacity is
+            TOTAL slots (the :662-667 capacity rule with preemption
+            on). Decode: per cell (group, machine), min(grant,
+            residents) residents are retained in row order; remaining
+            grants go to "movers" (displaced residents + backlog),
+            yielding MIGRATE for re-granted residents, PLACE for fresh
+            tasks, PREEMPT for residents left without a grant. A
+            displaced resident can never be re-granted its own machine
+            (rem[g,m] > 0 forces retained[g,m] = R[g,m]), so the three
+            delta kinds are disjoint by construction. Full-width
+            decode: the window optimization doesn't apply when placed
+            tasks are in play."""
+            enabled_pu = jnp.repeat(state.machine_enabled, P)
+            col_cap_m = jnp.where(state.machine_enabled, i32(P * S), i32(0))
+            live = state.live
+            placed = live & (state.pu >= 0)
+            cur_pu = jnp.clip(state.pu, 0, num_pus - 1)
+            cur_m = jnp.where(placed, cur_pu // P, i32(M))  # sentinel M
+            g_t = (state.job * i32(C) + state.cls) if per_job else state.cls
+            g_safe = jnp.where(live, g_t, i32(Gn))
+            supply = jnp.zeros(Gn + 1, i32).at[g_safe].add(1)[:Gn]
+            total = jnp.sum(supply)
+
+            if cost_fn is not None:
+                cost_cm = cost_fn(census_of(state)).astype(i32)
+            else:
+                cost_cm = jnp.zeros((C, M), i32)
+            cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
+            w = cost_gm + i32(e_cost) - u_row[:, None]
+            cost_overflow = (
+                jnp.max(jnp.abs(w)) + i32(discount)
+            ) >= i32(COST_SCALE_LIMIT // n_scale)
+
+            # resident census per cell [Gn, M] (placed live tasks)
+            cell = jnp.where(placed, g_safe * i32(M) + cur_m, i32(Gn * M))
+            R_real = (
+                jnp.zeros(Gn * M + 1, i32).at[cell].add(1)[: Gn * M]
+            ).reshape(Gn, M)
+
+            wS_hi = jnp.zeros((Gn, Mp), i32).at[:, :M].set(w * i32(n_scale))
+            wS_lo = wS_hi.at[:, :M].add(-i32(discount * n_scale))
+            R_pad = jnp.zeros((Gn, Mp), i32).at[:, :M].set(R_real)
+            col_cap = (
+                jnp.zeros(Mp, i32).at[:M].set(col_cap_m).at[Mp - 1].set(total)
+            )
+            if discount == 0:
+                # tiers coincide: the ordinary solve (incl. the
+                # degenerate collapse) is exact on the all-live supply
+                y, _pm, solve_steps, converged = transport_fori(
+                    wS_hi, supply, col_cap, supersteps, alpha=alpha,
+                    eps0=default_eps0(n_scale),
+                    class_degenerate=class_degenerate,
+                )
+            else:
+                y, _pm, solve_steps, converged = transport_fori_tiered(
+                    wS_lo, wS_hi, R_pad, supply, col_cap, supersteps,
+                    alpha=alpha, eps0=default_eps0(n_scale),
+                )
+            y_real = y[:, :M]
+
+            # ---- decode ----
+            retained = jnp.minimum(y_real, R_real)  # residents kept
+            rem = y_real - retained  # grants for movers
+
+            # per-cell resident ranks (row order) via one stable sort
+            order = jnp.argsort(cell, stable=True)
+            counts = jnp.zeros(Gn * M + 1, i32).at[cell].add(1)
+            starts = jnp.cumsum(counts) - counts
+            rank_sorted = jnp.arange(Tcap, dtype=i32) - starts[cell[order]]
+            rank_cell = jnp.zeros(Tcap, i32).at[order].set(rank_sorted)
+            ret_flat = jnp.concatenate([retained.reshape(-1), jnp.zeros(1, i32)])
+            stay = placed & (rank_cell < ret_flat[jnp.clip(cell, 0, Gn * M)])
+
+            # movers: every live task not staying; their grants fill
+            # the slots left after stays
+            mover = live & ~stay
+            g_mv = jnp.where(mover, g_t, i32(Gn))
+            stay_pu = jnp.where(stay, cur_pu, num_pus)
+            pu_stay = jnp.zeros(num_pus + 1, i32).at[stay_pu].add(1)[:num_pus]
+            pu_free_mv = jnp.where(enabled_pu, i32(S) - pu_stay, i32(0))
+            granted, pu_abs = rank_match_decode(g_mv, rem, pu_free_mv)
+
+            new_pu = jnp.where(
+                stay, state.pu, jnp.where(granted, pu_abs, i32(-1))
+            )
+            final_on = live & (new_pu >= 0)
+            pu_idx = jnp.where(final_on, new_pu, num_pus)
+            pu_running = jnp.zeros(num_pus + 1, i32).at[pu_idx].add(1)[:num_pus]
+
+            placed_total = jnp.sum(y_real, dtype=i32)
+            # objective: placements at (cost + e), retained residents
+            # rebated by the discount, escapes at the group unsched cost
+            objective = (
+                jnp.sum((cost_gm + i32(e_cost)) * y_real)
+                - i32(discount) * jnp.sum(retained)
+                + jnp.sum(u_row * (supply - jnp.sum(y_real, axis=1)))
+            )
+            stats = {
+                "placed": jnp.sum(granted & ~placed, dtype=i32),
+                "migrated": jnp.sum(granted & placed, dtype=i32),
+                "preempted": jnp.sum(placed & ~stay & ~granted, dtype=i32),
+                "unscheduled": total - placed_total,
+                "converged": converged,
+                "cost_overflow": cost_overflow,
+                "objective": objective,
+                "live": total,
                 "supersteps": solve_steps,
             }
             return state._replace(pu=new_pu, pu_running=pu_running), stats
@@ -474,16 +626,21 @@ class DeviceBulkCluster:
             # the one-shot round() keeps the full width (fill path).
             # The random offset rotates the window over the backlog so
             # no pending task can be starved by earlier-row escapees.
-            state, stats = round_core(
-                state,
-                decode_width=steady_decode_width,
-                window_offset=jax.random.randint(k4, (), 0, 1 << 30),
-            )
+            # Preemption mode always decodes full-width (placed tasks
+            # are in play every round).
+            if preempt:
+                state, stats = round_core_preempt(state)
+            else:
+                state, stats = round_core(
+                    state,
+                    decode_width=steady_decode_width,
+                    window_offset=jax.random.randint(k4, (), 0, 1 << 30),
+                )
             stats["completed"] = jnp.sum(done, dtype=i32)
             stats["admitted"] = admitted
             return state, stats
 
-        self._round_jit = jax.jit(round_core)
+        self._round_jit = jax.jit(round_core_preempt if preempt else round_core)
         self._admit_jit = jax.jit(admit)
         self._complete_jit = jax.jit(complete)
         self._set_machine_jit = jax.jit(set_machine, static_argnums=(2,))

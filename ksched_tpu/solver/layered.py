@@ -70,6 +70,15 @@ def validate_job_unsched_cost(job_unsched_cost, num_jobs: int):
         raise ValueError(
             f"job_unsched_cost must have shape ({num_jobs},), got {out.shape}"
         )
+    # Values at or beyond COST_SCALE_LIMIT are guaranteed to overflow
+    # once scaled — and the device path casts to int32 BEFORE its
+    # in-graph guard, so an unchecked huge cost would silently wrap to
+    # a strongly-negative escape instead of raising like the host path.
+    if out.size and int(np.abs(out).max()) >= COST_SCALE_LIMIT:
+        raise OverflowError(
+            f"job_unsched_cost magnitude {int(np.abs(out).max())} exceeds "
+            f"the scaled-cost limit {COST_SCALE_LIMIT}"
+        )
     return out
 
 
@@ -261,6 +270,231 @@ def transport_superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps):
     relabel_sink = (e_sink > 0) & (pushed_sink == 0)
     psink2 = jnp.where(relabel_sink, cand_sink - eps, psink)
     return y2, z2, pr2, pm2, psink2
+
+
+# ---------------------------------------------------------------------------
+# Tiered (continuation-priced) transport: the preemption-on formulation
+# ---------------------------------------------------------------------------
+#
+# With preemption on (graph_manager.go:855-888), placed tasks re-enter
+# every round's solve: machine capacity is total slots (the capacity
+# rule flips, :662-667) and a task's CURRENT machine offers a cheaper
+# "continuation" price than a fresh placement (TaskContinuationCost vs
+# TaskToResourceNodeCost, costmodel/interface.go:75-79). In aggregate
+# form each cell (row g, machine m) prices its first R[g,m] units (the
+# residents) at wLo = w - discount and the rest at w. A per-cell convex
+# two-tier cost is exactly a pair of parallel arcs, so cost-scaling
+# push-relabel remains exact: every residual/relabel rule below is the
+# parallel-arc rule with the canonical cheapest-first split
+# yA = min(y, R), yB = y - yA.
+
+
+def transport_saturate_tiered(wLo, wHi, R, U, col_cap, y, z, pr, pm, psink):
+    """Phase-start saturation, per tier (wLo <= wHi cellwise, so a
+    saturated cheap tier is implied by a saturated dear one)."""
+    i32 = jnp.int32
+    rcl = wLo + pr[:, None] - pm[None, :]
+    rch = wHi + pr[:, None] - pm[None, :]
+    yA = jnp.minimum(y, R)
+    yB = y - yA
+    yA2 = jnp.where(rcl < 0, R, jnp.where(rcl > 0, i32(0), yA))
+    yB2 = jnp.where(rch < 0, U - R, jnp.where(rch > 0, i32(0), yB))
+    rcs = pm - psink
+    z2 = jnp.where(rcs < 0, col_cap, jnp.where(rcs > 0, i32(0), z))
+    return yA2 + yB2, z2
+
+
+def transport_superstep_tiered(
+    wLo, wHi, R, U, supply, col_cap, y, z, pr, pm, psink, eps
+):
+    """One synchronous push/relabel wave over the two-tier residual
+    graph. Identical structure to transport_superstep, with forward and
+    backward residuals split by tier (cheap tier fills first, dear tier
+    empties first — the canonical split of a convex arc cost)."""
+    i32 = jnp.int32
+    big = jnp.int32(_BIG)
+    e_row, e_col, e_sink = _excesses(supply, y, z)
+    yA = jnp.minimum(y, R)
+    yB = y - yA
+    rcl = wLo + pr[:, None] - pm[None, :]
+    rch = wHi + pr[:, None] - pm[None, :]
+
+    # --- rows push forward: tier-A residual at wLo, tier-B at wHi ---
+    rA = R - yA
+    rB = (U - R) - yB
+    r_adm = jnp.where((rA > 0) & (rcl < 0), rA, i32(0)) + jnp.where(
+        (rB > 0) & (rch < 0), rB, i32(0)
+    )
+    excl = jnp.cumsum(r_adm, axis=1) - r_adm
+    delta_f = jnp.clip(e_row[:, None] - excl, 0, r_adm)
+
+    # --- columns push: sink first, then dear-tier returns, then cheap ---
+    r_s = col_cap - z
+    rc_s = pm - psink
+    rcb_hi = pm[None, :] - pr[:, None] - wHi  # backward tier B (cost -wHi)
+    rcb_lo = pm[None, :] - pr[:, None] - wLo  # backward tier A
+    colA = jnp.concatenate(
+        [
+            jnp.where((r_s > 0) & (rc_s < 0), r_s, i32(0))[None, :],
+            jnp.where((yB > 0) & (rcb_hi < 0), yB, i32(0)),
+            jnp.where((yA > 0) & (rcb_lo < 0), yA, i32(0)),
+        ],
+        axis=0,
+    )  # [1 + 2C, Mp1]
+    C = y.shape[0]
+    exclA = jnp.cumsum(colA, axis=0) - colA
+    deltaA = jnp.clip(e_col[None, :] - exclA, 0, colA)
+    delta_s = deltaA[0]
+    delta_b = deltaA[1 : 1 + C] + deltaA[1 + C :]
+
+    # --- sink pushes back (tier-less, as before) ---
+    r_zb = z
+    rc_zb = psink - pm
+    zb_adm = jnp.where((r_zb > 0) & (rc_zb < 0), r_zb, i32(0))
+    excl_zb = jnp.cumsum(zb_adm) - zb_adm
+    delta_zb = jnp.clip(e_sink - excl_zb, 0, zb_adm)
+
+    y2 = y + delta_f - delta_b
+    z2 = z + delta_s - delta_zb
+
+    # --- jump relabels (candidates consider both tiers' residuals) ---
+    pushed_row = jnp.sum(delta_f, axis=1)
+    cand_row = jnp.maximum(
+        jnp.max(jnp.where(rA > 0, pm[None, :] - wLo, -big), axis=1),
+        jnp.max(jnp.where(rB > 0, pm[None, :] - wHi, -big), axis=1),
+    )
+    relabel_row = (e_row > 0) & (pushed_row == 0)
+    pr2 = jnp.where(relabel_row, cand_row - eps, pr)
+
+    pushed_col = delta_s + jnp.sum(delta_b, axis=0)
+    cand_col = jnp.maximum(
+        jnp.maximum(
+            jnp.max(jnp.where(yA > 0, pr[:, None] + wLo, -big), axis=0),
+            jnp.max(jnp.where(yB > 0, pr[:, None] + wHi, -big), axis=0),
+        ),
+        jnp.where(r_s > 0, psink, -big),
+    )
+    relabel_col = (e_col > 0) & (pushed_col == 0)
+    pm2 = jnp.where(relabel_col, cand_col - eps, pm)
+
+    pushed_sink = jnp.sum(delta_zb)
+    cand_sink = jnp.max(jnp.where(z > 0, pm, -big))
+    relabel_sink = (e_sink > 0) & (pushed_sink == 0)
+    psink2 = jnp.where(relabel_sink, cand_sink - eps, psink)
+    return y2, z2, pr2, pm2, psink2
+
+
+def _transport_loop_tiered(wLo, wHi, R, U, supply, col_cap, eps_init, alpha,
+                           max_supersteps):
+    """Tiered twin of _transport_loop (cold start: tightening against
+    the cheap tier makes the zero flow 0-optimal, since wLo <= wHi)."""
+    i32 = jnp.int32
+
+    def phase_cond(state):
+        *_rest, steps, done = state
+        return ~done & (steps < max_supersteps)
+
+    def phase_body(state):
+        y, z, pr, pm, psink, eps, steps, done = state
+        e_row, e_col, e_sink = _excesses(supply, y, z)
+        any_active = jnp.any(e_row > 0) | jnp.any(e_col > 0) | (e_sink > 0)
+
+        def do_step(_):
+            y2, z2, pr2, pm2, psink2 = transport_superstep_tiered(
+                wLo, wHi, R, U, supply, col_cap, y, z, pr, pm, psink, eps
+            )
+            return y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False)
+
+        def next_phase(_):
+            finished = eps <= 1
+            new_eps = jnp.maximum(i32(1), eps // alpha)
+            y2, z2 = transport_saturate_tiered(
+                wLo, wHi, R, U, col_cap, y, z, pr, pm, psink
+            )
+            return (
+                jnp.where(finished, y, y2),
+                jnp.where(finished, z, z2),
+                pr, pm, psink,
+                jnp.where(finished, eps, new_eps),
+                steps,
+                finished,
+            )
+
+        return lax.cond(any_active, do_step, next_phase, operand=None)
+
+    C, Mp1 = wLo.shape
+    pr0, pm0, psink0 = transport_tighten(wLo, U, col_cap, None)
+    y0 = jnp.zeros((C, Mp1), jnp.int32)
+    z0 = jnp.zeros((Mp1,), jnp.int32)
+    state = (y0, z0, pr0, pm0, psink0, eps_init, jnp.int32(0), jnp.bool_(False))
+    y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
+        phase_cond, phase_body, state
+    )
+    e_row, e_col, e_sink = _excesses(supply, y, z)
+    max_abs = jnp.maximum(
+        jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
+    )
+    return y, z, pm, steps, done & (max_abs == 0)
+
+
+def solve_single_class_tiered(wLo, wHi, R, supply, col_cap):
+    """EXACT closed form for one tiered row: expand each column into a
+    cheap tier (cap min(R, col_cap), cost wLo) and a base tier (the
+    rest at wHi), then greedy-fill strictly-profitable capacity by
+    sorted marginal cost — valid because the per-cell cost is convex
+    (the cheap tier always fills first). Returns y int32[Mp1] (tier
+    totals per column)."""
+    i32 = jnp.int32
+    Mp1 = wLo.shape[0]
+    Reff = jnp.minimum(R, col_cap)
+    w2 = jnp.concatenate([wLo, wHi])
+    cap2 = jnp.concatenate([Reff, col_cap - Reff])
+    take = jnp.where(w2 < 0, cap2, i32(0))
+    order = jnp.argsort(w2)
+    take_s = take[order]
+    excl = jnp.cumsum(take_s) - take_s
+    y_s = jnp.clip(supply - excl, 0, take_s)
+    inv = jnp.argsort(order)
+    y2 = y_s[inv]
+    return y2[:Mp1] + y2[Mp1:]
+
+
+def transport_fori_tiered(wLo, wHi, R, supply, col_cap, num_supersteps: int,
+                          alpha: int = 8, eps0: Optional[int] = None):
+    """Bounded tiered transport solve, embeddable in jitted programs —
+    the preemption-on twin of transport_fori. Runs as the XLA phase
+    loop (no fused Pallas variant yet; the tiered residual rules double
+    the per-superstep mask work, so the kernel port is a separate
+    lift). Single-row instances take the exact closed form. Returns
+    (y, pm, steps, converged)."""
+    C, Mp1 = wLo.shape
+    i32 = jnp.int32
+    R = jnp.minimum(R, jnp.minimum(supply[:, None], col_cap[None, :]))
+    U = jnp.minimum(supply[:, None], col_cap[None, :])
+    if C == 1:
+        y = solve_single_class_tiered(wLo[0], wHi[0], R[0], supply[0], col_cap)
+        return y[None, :], jnp.zeros_like(col_cap), i32(0), jnp.bool_(True)
+
+    eps_full = jnp.maximum(jnp.max(jnp.abs(wHi)), i32(1))
+
+    def run(eps_init):
+        y, _z, pm, steps, conv = _transport_loop_tiered(
+            wLo, wHi, R, U, supply, col_cap, eps_init, alpha, num_supersteps
+        )
+        return y, pm, steps, conv
+
+    if eps0 is None:
+        return run(eps_full)
+    y1, pm1, s1, conv1 = run(i32(eps0))
+
+    def keep(_):
+        return y1, pm1, s1, conv1
+
+    def retry(_):
+        y2, pm2, s2, conv2 = run(eps_full)
+        return y2, pm2, s1 + s2, conv2
+
+    return lax.cond(conv1, keep, retry, operand=None)
 
 
 def solve_single_class(w, supply, col_cap):

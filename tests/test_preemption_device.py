@@ -1,0 +1,332 @@
+"""Preemption on the device/bulk path: the tiered (continuation-priced)
+transport and its keep-arcs semantics (graph_manager.go:855-888,
+capacity rule :662-667), checked three ways:
+
+- the tiered kernel against a parallel-arc SSP oracle (exactness);
+- MIGRATE parity: an interference-cost shift must move the same tasks
+  on the device path as on the host graph path (FlowScheduler with
+  preemption=True and a matching cost model);
+- PREEMPT parity: a cost spike above the escape cost must evict on
+  both paths.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
+from ksched_tpu.solver.layered import transport_fori_tiered
+
+
+# ---------------------------------------------------------------------------
+# tiered transport exactness vs a parallel-arc oracle
+# ---------------------------------------------------------------------------
+
+
+def _oracle_objective(wLo, wHi, R, supply, col_cap):
+    """SSP reference solve of the parallel-arc expansion: per cell, a
+    cheap arc (cap R, cost wLo) plus a base arc (the rest at wHi)."""
+    from ksched_tpu.graph.device_export import FlowProblem
+    from ksched_tpu.solver.cpu_ref import ReferenceSolver
+
+    C, Mp1 = wLo.shape
+    sink = C + Mp1
+    src, dst, cap, cost = [], [], [], []
+    U = np.minimum(supply[:, None], col_cap[None, :])
+    Re = np.minimum(R, U)
+    for c in range(C):
+        for m in range(Mp1):
+            if Re[c, m] > 0:
+                src.append(c); dst.append(C + m)
+                cap.append(Re[c, m]); cost.append(wLo[c, m])
+            if U[c, m] - Re[c, m] > 0:
+                src.append(c); dst.append(C + m)
+                cap.append(U[c, m] - Re[c, m]); cost.append(wHi[c, m])
+    for m in range(Mp1):
+        src.append(C + m); dst.append(sink)
+        cap.append(col_cap[m]); cost.append(0)
+    excess = np.zeros(C + Mp1 + 1, np.int64)
+    excess[:C] = supply
+    excess[sink] = -supply.sum()
+    p = FlowProblem(
+        num_nodes=C + Mp1 + 1, excess=excess,
+        node_type=np.zeros(C + Mp1 + 1, np.int8),
+        src=np.array(src, np.int32), dst=np.array(dst, np.int32),
+        cap=np.array(cap, np.int32), cost=np.array(cost, np.int32),
+        flow_offset=np.zeros(len(src), np.int32), num_arcs=len(src),
+    )
+    return ReferenceSolver().solve(p).objective
+
+
+def test_tiered_transport_matches_parallel_arc_oracle():
+    rng = np.random.default_rng(3)
+    solve = jax.jit(transport_fori_tiered, static_argnums=(5, 6, 7))
+    for trial in range(12):
+        C = int(rng.integers(2, 5))
+        Mp1 = int(rng.integers(3, 9)) + 1
+        n_scale = 64  # > node count: eps=1 termination is exact
+        w = rng.integers(-8, 9, (C, Mp1)).astype(np.int32) * n_scale
+        w[:, -1] = 0  # unsched column
+        d = rng.integers(0, 5, (C, Mp1)).astype(np.int32) * n_scale
+        d[:, -1] = 0
+        supply = rng.integers(0, 12, C).astype(np.int32)
+        col_cap = rng.integers(0, 6, Mp1).astype(np.int32)
+        col_cap[-1] = supply.sum()
+        R = rng.integers(0, 4, (C, Mp1)).astype(np.int32)
+        R[:, -1] = 0
+        y, _pm, steps, conv = solve(
+            jnp.asarray(w - d), jnp.asarray(w), jnp.asarray(R),
+            jnp.asarray(supply), jnp.asarray(col_cap),
+            50_000, 8, n_scale // 16,
+        )
+        assert bool(conv), f"trial {trial} did not converge"
+        y = np.asarray(y)
+        U = np.minimum(supply[:, None], col_cap[None, :])
+        Re = np.minimum(R, U)
+        assert (y >= 0).all() and (y <= U).all()
+        assert (y.sum(axis=1) == supply).all()
+        assert (y.sum(axis=0) <= col_cap).all()
+        yA = np.minimum(y, Re)
+        obj = int(((w - d) * yA).sum() + (w * (y - yA)).sum())
+        assert obj == _oracle_objective(
+            w - d, w, R, supply.astype(np.int64), col_cap.astype(np.int64)
+        ), f"trial {trial}: objective mismatch"
+
+
+# ---------------------------------------------------------------------------
+# graph-path parity scenarios
+# ---------------------------------------------------------------------------
+
+UNSCHED = 30
+DISCOUNT = 1
+
+
+def _build_graph_cluster(num_machines, slots, interference, base_scale):
+    """FlowScheduler with preemption=True and a cost model matching the
+    device twin: cost[c, m] = interference * other_class_running(m)
+    + (1 + c) * base_scale * machine_index(m); continuation = current
+    machine's cost - DISCOUNT; escape/preemption = UNSCHED."""
+    from ksched_tpu.costmodels.census import CLASS_ECS
+    from ksched_tpu.costmodels.coco import CocoCostModel
+    from ksched_tpu.drivers import build_cluster
+    from ksched_tpu.utils import resource_id_from_string
+
+    class ShiftModel(CocoCostModel):
+        machine_index = {}  # rid -> index, filled after build
+
+        def _machine_cost(self, task_class, resource_id):
+            census = self.census.machine_census(resource_id)
+            other = int(census.sum()) - int(census[task_class])
+            return (
+                interference * other
+                + (1 + task_class) * base_scale * self.machine_index[resource_id]
+            )
+
+        def task_to_unscheduled_agg_cost(self, task_id):
+            return UNSCHED
+
+        def task_preemption_cost(self, task_id):
+            return UNSCHED
+
+        def task_continuation_cost(self, task_id):
+            td = self.task_map.find(task_id)
+            rid = resource_id_from_string(td.scheduled_to_resource)
+            while rid not in self.machine_index:
+                rs = self.resource_map.find(rid)
+                rid = resource_id_from_string(rs.topology_node.parent_id)
+            c = self.census.task_class(task_id)
+            return self._machine_cost(c, rid) - DISCOUNT
+
+        def equiv_class_to_resource_node(self, ec, resource_id):
+            from ksched_tpu.costmodels.census import ec_class
+
+            c = ec_class(ec)
+            if c is None:
+                return 0, 0
+            rs = self.resource_map.find(resource_id)
+            # preemption-on capacity: TOTAL slots (rule :662-667 flips)
+            return self._machine_cost(c, resource_id), rs.descriptor.num_slots_below
+
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=num_machines, num_cores=1, pus_per_core=1,
+        max_tasks_per_pu=slots, cost_model_factory=ShiftModel,
+        preemption=True,
+    )
+    for i, child in enumerate(root.children):
+        rid = resource_id_from_string(child.resource_desc.uuid)
+        ShiftModel.machine_index[rid] = i
+    return sched, rmap, jmap, tmap, root, ShiftModel.machine_index
+
+
+def _add_tasks_with_classes(sched, jmap, tmap, jid, classes):
+    from ksched_tpu.data import TaskType
+    from ksched_tpu.drivers.synthetic import add_task_to_job
+
+    tds = []
+    for c in classes:
+        td = add_task_to_job(jid, jmap, tmap)
+        td.task_type = TaskType(c)
+        tds.append(td)
+    jd = jmap.find(jid)
+    if jid not in sched.jobs_to_schedule:
+        sched.add_job(jd)
+    return tds
+
+
+def _delta_counts(deltas):
+    from ksched_tpu.data import DeltaType
+
+    out = {"PLACE": 0, "MIGRATE": 0, "PREEMPT": 0}
+    for d in deltas:
+        if d.type == DeltaType.PLACE:
+            out["PLACE"] += 1
+        elif d.type == DeltaType.MIGRATE:
+            out["MIGRATE"] += 1
+        elif d.type == DeltaType.PREEMPT:
+            out["PREEMPT"] += 1
+    return out
+
+
+def _graph_census(sched, tmap, machine_index, rmap, num_machines):
+    """per-(machine, class) running counts from the bindings."""
+    from ksched_tpu.utils import resource_id_from_string
+
+    census = np.zeros((num_machines, 4), np.int64)
+    for tid, rid in sched.task_bindings.items():
+        while rid not in machine_index:
+            rs = rmap.find(rid)
+            rid = resource_id_from_string(rs.topology_node.parent_id)
+        census[machine_index[rid], int(tmap.find(tid).task_type)] += 1
+    return census
+
+
+def _device_cost_fn(interference, base_scale, M):
+    base = jnp.arange(M, dtype=jnp.int32)
+
+    def cost_fn(census):  # census [M, C]
+        other = census.sum(axis=1, keepdims=True) - census  # [M, C]
+        C = census.shape[1]
+        scale = (1 + jnp.arange(C, dtype=jnp.int32))[:, None]  # [C, 1]
+        return (interference * other.T + scale * base_scale * base[None, :]).astype(
+            jnp.int32
+        )
+
+    return cost_fn
+
+
+def test_device_preemption_migration_parity_with_graph_path():
+    """Interference shift: two co-located tasks of different classes;
+    a third arrival makes class 0 cheaper elsewhere. Unique optimum:
+    the class-0 resident MIGRATES, the arrival PLACES next to it, the
+    class-1 resident stays. Both paths must agree."""
+    rng_classes = [0, 1]
+    sched, rmap, jmap, tmap, root, machine_index = _build_graph_cluster(
+        num_machines=2, slots=2, interference=10, base_scale=1
+    )
+    from ksched_tpu.utils import rand_uint64
+
+    jid = rand_uint64()
+    _add_tasks_with_classes(sched, jmap, tmap, jid, rng_classes)
+    n, deltas = sched.schedule_all_jobs()
+    assert n == 2
+    census1 = _graph_census(sched, tmap, machine_index, rmap, 2)
+    assert census1[0, 0] == 1 and census1[0, 1] == 1  # both on machine 0
+
+    _add_tasks_with_classes(sched, jmap, tmap, jid, [0])
+    n2, deltas2 = sched.schedule_all_jobs()
+    graph_counts = _delta_counts(deltas2)
+    census2 = _graph_census(sched, tmap, machine_index, rmap, 2)
+    assert graph_counts == {"PLACE": 1, "MIGRATE": 1, "PREEMPT": 0}
+    assert census2[1, 0] == 2 and census2[0, 1] == 1
+
+    # device twin, same scenario
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=2, num_jobs=1,
+        num_task_classes=2, task_capacity=16,
+        class_cost_fn=_device_cost_fn(10, 1, 2),
+        preemption=True, continuation_discount=DISCOUNT,
+        unsched_cost=UNSCHED, ec_cost=0,
+    )
+    dev.add_tasks(2, classes=np.array(rng_classes, np.int32))
+    s1 = dev.fetch_stats(dev.round())
+    assert bool(s1["converged"]) and int(s1["placed"]) == 2
+    dev.add_tasks(1, classes=np.array([0], np.int32))
+    s2 = dev.fetch_stats(dev.round())
+    assert bool(s2["converged"])
+    dev_counts = {
+        "PLACE": int(s2["placed"]),
+        "MIGRATE": int(s2["migrated"]),
+        "PREEMPT": int(s2["preempted"]),
+    }
+    assert dev_counts == graph_counts
+    st = {k: np.asarray(v) for k, v in dev.fetch_state().items()}
+    on = st["live"] & (st["pu"] >= 0)
+    dev_census = np.zeros((2, 2), np.int64)
+    np.add.at(dev_census, (st["pu"][on], st["cls"][on]), 1)
+    assert (dev_census == census2[:, :2]).all()
+
+
+def test_device_preemption_preempt_parity_with_graph_path():
+    """Cost spike above the escape price: the resident is PREEMPTED
+    (continuation 34 > escape 30) and the arrival stays unscheduled on
+    both paths."""
+    sched, rmap, jmap, tmap, root, machine_index = _build_graph_cluster(
+        num_machines=1, slots=1, interference=0, base_scale=0
+    )
+
+    # cost = 35 * running count on the machine (same-class interference)
+    class_ = 0
+
+    def patch(model_cls):
+        def _machine_cost(self, task_class, resource_id):
+            census = self.census.machine_census(resource_id)
+            return 35 * int(census.sum())
+
+        model_cls._machine_cost = _machine_cost
+
+    patch(type(sched.cost_model))
+
+    from ksched_tpu.utils import rand_uint64
+
+    jid = rand_uint64()
+    _add_tasks_with_classes(sched, jmap, tmap, jid, [class_])
+    n, _ = sched.schedule_all_jobs()
+    assert n == 1
+    _add_tasks_with_classes(sched, jmap, tmap, jid, [class_])
+    _n2, deltas2 = sched.schedule_all_jobs()
+    graph_counts = _delta_counts(deltas2)
+    assert graph_counts == {"PLACE": 0, "MIGRATE": 0, "PREEMPT": 1}
+    assert not sched.task_bindings  # everyone off the machine
+
+    def cost_fn(census):
+        return (35 * census.sum(axis=1, keepdims=True).T).astype(jnp.int32)
+
+    dev = DeviceBulkCluster(
+        num_machines=1, pus_per_machine=1, slots_per_pu=1, num_jobs=1,
+        num_task_classes=1, task_capacity=8, class_cost_fn=cost_fn,
+        preemption=True, continuation_discount=DISCOUNT,
+        unsched_cost=UNSCHED, ec_cost=0,
+    )
+    dev.add_tasks(1)
+    s1 = dev.fetch_stats(dev.round())
+    assert int(s1["placed"]) == 1
+    dev.add_tasks(1)
+    s2 = dev.fetch_stats(dev.round())
+    assert bool(s2["converged"])
+    assert {
+        "PLACE": int(s2["placed"]),
+        "MIGRATE": int(s2["migrated"]),
+        "PREEMPT": int(s2["preempted"]),
+    } == graph_counts
+    assert dev.num_placed_tasks == 0
+    assert int(s2["unscheduled"]) == 2
+
+
+def test_device_preemption_rejects_decode_window():
+    with pytest.raises(ValueError):
+        DeviceBulkCluster(
+            num_machines=2, pus_per_machine=1, slots_per_pu=1, num_jobs=1,
+            task_capacity=16, preemption=True, decode_width=4,
+        )
