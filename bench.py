@@ -48,6 +48,16 @@ def _solver_work(backend) -> int:
     return getattr(backend, "last_supersteps", None) or getattr(backend, "last_iterations", 0)
 
 
+#: the tunneled-TPU completion-polling floor (docs/NOTES.md): wall-clock
+#: readings of device work are only trustworthy once a timed region
+#: exceeds this by a wide margin — short work reads artificially fast
+#: (microseconds), so a per-round number derived from a sub-floor chunk
+#: is an artifact, not a measurement.
+FLOOR_MS = 110.0
+#: a timed chunk must clear the floor by this factor to be believed
+FLOOR_MARGIN = 5.0
+
+
 def _device_bench(
     *,
     tasks: int,
@@ -115,17 +125,64 @@ def _device_bench(
     jax.block_until_ready(fill)
     fill_s = time.perf_counter() - t0
 
+    # --- chunk sizing against the polling floor ---------------------
+    # A chunk of R data-dependent rounds is timed as one unit; its wall
+    # time must clear the documented completion-polling floor by
+    # FLOOR_MARGIN before the per-round quotient is believable. Walls
+    # measured BELOW the floor are artifacts (they read microseconds),
+    # so R cannot be scaled proportionally from them — it grows
+    # geometrically until a probe chunk clears the bar. The floor is a
+    # property of the tunneled-TPU transport; on the CPU platform the
+    # clock is honest and chunking is only amortization.
+    platform = devices[0].platform
+    min_wall_ms = FLOOR_MS * FLOOR_MARGIN if platform != "cpu" else 0.0
     R = min(chunk, rounds)
-    # warm the scan executable
-    jax.block_until_ready(dev.run_steady_rounds(R, churn, churn_n, seed=1))
-    chunks = max(1, -(-rounds // R))  # ceil: measure >= requested rounds
+    while True:
+        # warm the scan executable for this R (num_rounds is static)
+        jax.block_until_ready(dev.run_steady_rounds(R, churn, churn_n, seed=1))
+        t0 = time.perf_counter()
+        probe = dev.run_steady_rounds(R, churn, churn_n, seed=1)
+        jax.block_until_ready(probe)
+        probe_ms = (time.perf_counter() - t0) * 1e3
+        if probe_ms >= min_wall_ms or R >= (1 << 20):
+            break
+        if verbose:
+            print(
+                f"# probe chunk R={R}: wall {probe_ms:.1f} ms under the "
+                f"{min_wall_ms:.0f} ms floor bar - growing R",
+                file=sys.stderr,
+            )
+        R *= 8
+    if probe_ms < min_wall_ms:
+        raise RuntimeError(
+            f"chunk wall {probe_ms:.2f} ms below {min_wall_ms:.0f} ms at "
+            f"R={R}: per-round latency unmeasurable over this transport"
+        )
+
+    chunks = max(3, -(-rounds // R))  # >= 3 chunks for a meaningful p50
     per_round_ms = []
+    chunk_walls_ms = []
     chunk_stats = []
     for rep in range(chunks):
         t0 = time.perf_counter()
         stats = dev.run_steady_rounds(R, churn, churn_n, seed=2 + rep)
         jax.block_until_ready(stats)
-        per_round_ms.append((time.perf_counter() - t0) / R * 1e3)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        if wall_ms < min_wall_ms:
+            # transport flakiness (documented: occasional impossibly
+            # fast readings) - retry the chunk once, then fail loudly
+            t0 = time.perf_counter()
+            stats = dev.run_steady_rounds(R, churn, churn_n, seed=100 + rep)
+            jax.block_until_ready(stats)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            if wall_ms < min_wall_ms:
+                raise RuntimeError(
+                    f"chunk {rep} wall {wall_ms:.2f} ms below the "
+                    f"{min_wall_ms:.0f} ms floor bar twice - rejecting "
+                    "the measurement"
+                )
+        chunk_walls_ms.append(round(wall_ms, 1))
+        per_round_ms.append(wall_ms / R)
         chunk_stats.append(stats)
 
     # Clock stopped — now fetch and verify everything.
@@ -138,13 +195,19 @@ def _device_bench(
             f"unsched={int(fill_got['unscheduled'])}",
             file=sys.stderr,
         )
+    ss_all, placed_all, live_last = [], [], 0
     for rep, stats in enumerate(chunk_stats):
         got = dev.fetch_stats(stats)
         assert got["converged"].all(), "a steady round did not converge"
+        ss = got.get("supersteps")
+        if ss is not None:
+            ss_all.append(np.asarray(ss))
+        placed_all.append(np.asarray(got["placed"]))
+        live_last = int(got["live"][-1])
         if verbose:
-            ss = got.get("supersteps")
             print(
-                f"# chunk {rep}: {per_round_ms[rep]:.3f} ms/round x {R} rounds, "
+                f"# chunk {rep}: {per_round_ms[rep]:.3f} ms/round x {R} rounds "
+                f"(wall {chunk_walls_ms[rep]:.0f} ms), "
                 f"placed/round mean {got['placed'].mean():.1f}, "
                 f"live {int(got['live'][-1])}"
                 + (f", supersteps mean {ss.mean():.0f} max {int(ss.max())}"
@@ -154,16 +217,28 @@ def _device_bench(
 
     p50 = float(np.percentile(per_round_ms, 50))
     target_ms = 10.0
+    detail = {
+        "rounds_per_chunk": R,
+        "chunks_wall_ms": chunk_walls_ms,
+        "floor_bar_ms": round(min_wall_ms, 1),
+        "placed_per_round_mean": round(float(np.mean(placed_all)), 2),
+        "live_final": live_last,
+    }
+    if ss_all:
+        ss_cat = np.concatenate(ss_all)
+        detail["supersteps_p50"] = int(np.percentile(ss_cat, 50))
+        detail["supersteps_max"] = int(ss_cat.max())
     return {
         "metric": (
             f"p50 scheduling-round latency, {tasks} tasks x "
             f"{machines} machines, {label}, "
             f"{churn:.0%} churn, device-resident rounds "
-            f"({R}-round chains), backend=device/{devices[0].platform}"
+            f"({R}-round chains), backend=device/{platform}"
         ),
-        "value": round(p50, 3),
+        "value": round(p50, 4),
         "unit": "ms",
         "vs_baseline": round(target_ms / p50, 3),
+        "detail": detail,
     }
 
 
@@ -297,7 +372,14 @@ def run_suite(args) -> None:
     for name in SUITE_CONFIGS:
         cmd = [sys.executable, __file__, "--config", name,
                "--rounds", str(args.rounds), "--chunk", str(args.chunk)]
-        if args.cpu:
+        if args.cpu or name == "gtrace12k":
+            # gtrace12k replays discrete host events through the host
+            # bulk path, which fetches results every round; on the
+            # tunneled TPU the FIRST fetch permanently degrades later
+            # dispatches to ~90 ms (docs/NOTES.md), so its per-round
+            # wall times over the tunnel measure the transport, not the
+            # scheduler. JAX-CPU timing is honest for this host-driven
+            # config; the metric line names the platform.
             cmd.append("--cpu")
         if args.verbose:
             cmd.append("--verbose")

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..solver.layered import (
     COST_SCALE_LIMIT,
-    default_eps0,
+    choose_eps0,
     pad_geometry,
     solve_single_class,
     transport_fori,
@@ -66,9 +66,15 @@ def _batch_solve(wS, supply, col_cap, n_scale, alpha, max_supersteps,
 
     def one(args):
         w, s, cap = args
+        # per-scenario adaptive start (oversubscribed scenarios — e.g.
+        # drain what-ifs removing more capacity than the backlog fits —
+        # take the full-range schedule; see choose_eps0)
+        eps_full = jnp.maximum(jnp.max(jnp.abs(w)), jnp.int32(1))
         y, _pm, _steps, conv = transport_fori(
             w, s, cap, max_supersteps, alpha=alpha,
-            eps0=default_eps0(n_scale),
+            eps0=choose_eps0(
+                n_scale, eps_full, jnp.sum(s), jnp.sum(cap[:-1])
+            ),
             class_degenerate=class_degenerate,
         )
         return y, conv

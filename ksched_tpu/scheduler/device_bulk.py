@@ -40,7 +40,7 @@ from jax import lax
 
 from ..solver.layered import (
     COST_SCALE_LIMIT,
-    default_eps0,
+    choose_eps0,
     pad_geometry,
     transport_fori,
     transport_fori_tiered,
@@ -218,7 +218,6 @@ class DeviceBulkCluster:
             to bf16 passes, whose 8-bit mantissa corrupts counts beyond
             256; all counts here are < 2^24, so f32 at HIGHEST is
             exact."""
-            W = g_safe.shape[0]
             hi = jax.lax.Precision.HIGHEST
             part = g_safe < i32(Gn)
             onehot = (
@@ -359,10 +358,15 @@ class DeviceBulkCluster:
             # interference-model instances, still exactly optimal (any
             # eps0 is valid off tightened potentials; the in-graph
             # fallback to the full schedule covers pathologies).
+            # Oversubscribed rounds (backlog > free slots) switch to
+            # the full-range start — see choose_eps0.
+            eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
             y, _pm, solve_steps, converged = transport_fori(
                 wS, supply, col_cap, supersteps,
                 alpha=alpha,
-                eps0=default_eps0(n_scale),
+                eps0=choose_eps0(
+                    n_scale, eps_full, total, jnp.sum(machine_free)
+                ),
                 class_degenerate=class_degenerate,
             )
             y_real = y[:, :M]
@@ -466,18 +470,22 @@ class DeviceBulkCluster:
             col_cap = (
                 jnp.zeros(Mp, i32).at[:M].set(col_cap_m).at[Mp - 1].set(total)
             )
+            eps_full = jnp.maximum(jnp.max(jnp.abs(wS_hi)), i32(1))
+            eps0 = choose_eps0(
+                n_scale, eps_full, total, jnp.sum(col_cap_m)
+            )
             if discount == 0:
                 # tiers coincide: the ordinary solve (incl. the
                 # degenerate collapse) is exact on the all-live supply
                 y, _pm, solve_steps, converged = transport_fori(
                     wS_hi, supply, col_cap, supersteps, alpha=alpha,
-                    eps0=default_eps0(n_scale),
+                    eps0=eps0,
                     class_degenerate=class_degenerate,
                 )
             else:
                 y, _pm, solve_steps, converged = transport_fori_tiered(
                     wS_lo, wS_hi, R_pad, supply, col_cap, supersteps,
-                    alpha=alpha, eps0=default_eps0(n_scale),
+                    alpha=alpha, eps0=eps0,
                 )
             y_real = y[:, :M]
 

@@ -133,10 +133,17 @@ def pad_geometry(num_machines: int, num_classes: int) -> Tuple[int, int]:
 
     Mp pads the machine axis to a lane-friendly multiple of 128 with
     room for the unsched column; n_scale is the cost multiplier that
-    makes eps=1 termination exact (smallest pow2 > node count)."""
+    makes eps=1 termination exact: smallest pow2 > the REAL node count
+    C + (M+1) + 1 (rows + live columns + sink). Padded columns have no
+    arcs (cap 0), so residual cycles traverse only live nodes and the
+    exactness bound is independent of Mp — deriving n_scale from Mp
+    would inflate the scaled-cost range (and with it the price ground
+    the eps=1 phase must cover, i.e. supersteps) by the pad factor; the
+    mesh-sharded solver pads Mp to a multiple of 128*devices, where
+    that inflation was measured at ~50x supersteps on small instances."""
     Mp = ((num_machines + 1 + 127) // 128) * 128
     n_scale = 1
-    while n_scale < num_classes + Mp + 2:
+    while n_scale < num_classes + num_machines + 3:
         n_scale <<= 1
     return Mp, n_scale
 
@@ -156,8 +163,33 @@ def default_eps0(n_scale: int) -> int:
     unit (n_scale) on contended interference instances, itself ~20x
     better than max|w|. Valid for any value — tightened potentials make
     the zero flow 0-optimal regardless; callers keep a full-range
-    fallback. One definition so the three solve sites cannot drift."""
+    fallback. One definition so the three solve sites cannot drift.
+
+    Only correct for instances that are NOT oversubscribed: when total
+    supply exceeds real machine capacity, prices must descend deep on
+    the unsched column and the short start pays for the descent in
+    eps-sized relabels (measured 1387 vs 284 supersteps on a 3x16 toy
+    at 1.25x oversubscription). Use choose_eps0 where supply/capacity
+    are at hand."""
     return max(1, n_scale // 16)
+
+
+def choose_eps0(n_scale: int, eps_full, supply_total, real_cap_total):
+    """Adaptive eps-schedule start: the tuned short start for the
+    common regime (supply fits real machine capacity — steady-state
+    backlogs vs free slots), the classic full-range start when the
+    instance is oversubscribed. Works on Python ints or traced scalars
+    (returns a traced scalar if any input is traced)."""
+    short = default_eps0(n_scale)
+    if isinstance(supply_total, (int, np.integer)) and isinstance(
+        real_cap_total, (int, np.integer)
+    ):
+        return eps_full if supply_total > real_cap_total else short
+    return jnp.where(
+        supply_total > real_cap_total,
+        jnp.int32(eps_full),
+        jnp.int32(short),
+    )
 
 
 def _excesses(supply, y, z):
@@ -494,7 +526,9 @@ def transport_fori_tiered(wLo, wHi, R, supply, col_cap, num_supersteps: int,
         y2, pm2, s2, conv2 = run(eps_full)
         return y2, pm2, s1 + s2, conv2
 
-    return lax.cond(conv1, keep, retry, operand=None)
+    # an eps0 already at the full range would retry the IDENTICAL solve
+    # (reachable since choose_eps0 picks eps_full on oversubscription)
+    return lax.cond(conv1 | (i32(eps0) >= eps_full), keep, retry, operand=None)
 
 
 def solve_single_class(w, supply, col_cap):
@@ -677,7 +711,11 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
         )
         return y2, pm2, s1 + s2, conv2
 
-    return lax.cond(conv1, keep, retry, operand=None)
+    # an eps0 already at the full range (and cold) would retry the
+    # IDENTICAL solve — reachable since choose_eps0 picks eps_full on
+    # oversubscription; skip unless a warm start pm0 differentiates it
+    same_retry = (i32(eps0) >= eps_full) if pm0 is None else jnp.bool_(False)
+    return lax.cond(conv1 | same_retry, keep, retry, operand=None)
 
 
 @functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
@@ -751,10 +789,13 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
         wS = jnp.asarray((wP * n_scale).astype(np.int32))
         sup = jnp.asarray(supply.astype(np.int32))
         cap = jnp.asarray(col_cap.astype(np.int32))
-        attempts = [
-            np.int32(default_eps0(n_scale)),
-            np.int32(max(1, max_w * n_scale)),
-        ]
+        eps_full = int(max(1, max_w * n_scale))
+        eps0 = int(
+            choose_eps0(n_scale, eps_full, total, int(lp.col_cap.sum()))
+        )
+        attempts = [np.int32(eps0)]
+        if eps0 != eps_full:
+            attempts.append(np.int32(eps_full))
         y = None
         converged = False
         # supersteps accumulate ACROSS attempts (matching the in-graph

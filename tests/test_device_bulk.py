@@ -138,7 +138,7 @@ def test_device_admit_shortfall_reported():
 
 
 def test_device_cost_overflow_flagged():
-    huge = 1 << 24  # * n_scale (>= 2^7 here) overflows 2^30
+    huge = 1 << 27  # * n_scale (>= 2^3 here: C+M+3=7 -> 8) reaches 2^30 >= COST_SCALE_LIMIT
 
     def cost_fn(census):
         return jnp.full((2, 2), huge, jnp.int32)
