@@ -1,0 +1,209 @@
+"""Quincy on the device fast path: interchangeability-group registry.
+
+The host graph path wires Quincy's per-task preference arcs directly
+into the flow graph (graph/graph_manager.py; reference:
+graph_manager.go:1229-1264 + costmodel/interface.go:105-110
+GetTaskPreferenceArcs) and solves CSR — correct, but ~160 us/superstep:
+no route to the <10 ms round regime at 10k x 1k. This module is the
+TPU-first alternative: tasks with the SAME cost signature — class,
+escape cost, and per-machine transfer-cost profile (i.e. the same input
+blocks) — are one transport commodity, so per-TASK preference arcs
+become per-GROUP preference columns (GroupSpec.pref_w) min'd into the
+class cost row, and the whole Quincy policy rides the dense [G, M]
+transport kernel (solver/layered.py; scheduler/device_bulk.py group
+mode).
+
+Exactness: grouping by full cost signature is the definition of
+interchangeability, so the aggregate collapse argument of
+solver/layered.py applies row-for-row; the effective per-cell cost
+min(EC route, preference arc) is exactly the cheaper of the two
+parallel paths a task has in the reference graph.
+
+In Quincy workloads the grouping is massively compressive: tasks
+reading the same block(s) share a signature (the map-task pattern), so
+G tracks the number of distinct inputs, not the number of tasks. Tasks
+whose signature would overflow the static group capacity fall back to
+the class's OVERFLOW group — no preferences, priced at the largest
+worst-case transfer seen among overflowed signatures, so their
+reported cost is conservative (never under the true route cost); the
+overflow count is reported so callers can size G_cap properly.
+
+The wait-cost starvation bound (QuincyCostModel.note_round,
+WAIT_COST_PER_ROUND) ages at GROUP granularity here: bump_wait raises
+the escape cost of groups that still have backlog. Tasks of one group
+are admitted and aged together, which preserves the bound's purpose —
+eventually waiting costs more than the worst placement.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .quincy import (
+    COST_PER_MB,
+    MB,
+    PREFERENCE_FRACTION,
+    WAIT_COST_PER_ROUND,
+    BlockRegistry,
+)
+
+#: re-exported sentinel (scheduler/device_bulk.py) so callers need one import
+from ..scheduler.device_bulk import PREF_NONE  # noqa: F401
+
+
+def _transfer_cost(total: int, local: int) -> int:
+    return (COST_PER_MB * max(0, total - local)) // MB
+
+
+class QuincyGroupTable:
+    """Host-side registry: task input signature -> transport group.
+
+    Maintains the numpy mirrors of GroupSpec and pushes them to a
+    DeviceBulkCluster via ``sync`` (host -> device upload only; the
+    round programs take the arrays as traced args, so no recompile).
+    """
+
+    def __init__(
+        self,
+        num_groups: int,
+        num_machines: int,
+        num_classes: int = 1,
+        wait_cost_per_round: int = WAIT_COST_PER_ROUND,
+    ) -> None:
+        if num_groups < 2 * num_classes:
+            raise ValueError(
+                f"need a fallback and an overflow group per class: "
+                f"G={num_groups} < 2*C={2 * num_classes}"
+            )
+        self.G = int(num_groups)
+        self.M = int(num_machines)
+        self.C = int(num_classes)
+        self.wait_cost_per_round = int(wait_cost_per_round)
+        self.blocks = BlockRegistry()
+        # Groups 0..C-1 are the classes' no-input fallback groups;
+        # C..2C-1 are the per-class OVERFLOW groups (signatures that
+        # arrive after the table fills): no preferences, e/u raised to
+        # the largest worst-case transfer among overflowed signatures —
+        # a conservative (never-undercharging) price.
+        self.cls = np.zeros(self.G, np.int32)
+        self.cls[: self.C] = np.arange(self.C)
+        self.cls[self.C : 2 * self.C] = np.arange(self.C)
+        self.job = np.zeros(self.G, np.int32)
+        self.e = np.zeros(self.G, np.int64)
+        self.u = np.ones(self.G, np.int64)  # worst(0) + 1
+        self.pref_w = np.full((self.G, self.M), PREF_NONE, np.int64)
+        self.wait_rounds = np.zeros(self.G, np.int64)
+        self._sig2gid: Dict[tuple, int] = {
+            (c, 0, ()): c for c in range(self.C)
+        }
+        self._next = 2 * self.C
+        self.overflowed = 0  # signatures dropped to the overflow group
+
+    # -- registration ------------------------------------------------------
+
+    def group_for(
+        self,
+        task_class: int,
+        block_ids: Sequence[int],
+        job: int = 0,
+    ) -> int:
+        """The group for a task of `task_class` reading `block_ids`
+        (sizes/locations from the block registry). Registers a new
+        group on first sight of a signature; overflows to the class's
+        no-preference fallback group when the table is full."""
+        total = 0
+        local: Dict[int, int] = {}
+        for b in block_ids:
+            size = self.blocks.size(b)
+            total += size
+            for m in self.blocks.holders(b):
+                local[m] = local.get(m, 0) + size
+        worst = _transfer_cost(total, 0)
+        threshold = PREFERENCE_FRACTION * total
+        prefs: List[Tuple[int, int]] = sorted(
+            (m, _transfer_cost(total, b))
+            for m, b in local.items()
+            if b > threshold and 0 <= m < self.M
+        )
+        sig = (int(task_class), worst, tuple(prefs))
+        gid = self._sig2gid.get(sig)
+        if gid is not None:
+            return gid
+        if not prefs and worst == 0:
+            return int(task_class)  # the fallback group IS this signature
+        if self._next >= self.G:
+            # table full: land in the class's overflow group, repriced
+            # upward to cover the costliest overflowed signature
+            self.overflowed += 1
+            gid = self.C + int(task_class)
+            self.e[gid] = max(self.e[gid], worst)
+            self.u[gid] = self.e[gid] + 1
+            return gid
+        gid = self._next
+        self._next += 1
+        self._sig2gid[sig] = gid
+        self.cls[gid] = int(task_class)
+        self.job[gid] = int(job)
+        # Route base: worst-case transfer (nothing local) — the task ->
+        # EC arc cost (QuincyCostModel.task_to_equiv_class_aggregator);
+        # escape: worst + 1 (+ wait aging) as in
+        # QuincyCostModel.task_to_unscheduled_agg_cost.
+        self.e[gid] = worst
+        self.u[gid] = worst + 1
+        for m, cost in prefs:
+            self.pref_w[gid, m] = cost
+        return gid
+
+    def groups_for(
+        self,
+        classes: np.ndarray,
+        deps: Sequence[Sequence[int]],
+        jobs: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Vector form of group_for for an admission batch."""
+        out = np.empty(len(deps), np.int32)
+        for i, blocks in enumerate(deps):
+            out[i] = self.group_for(
+                int(classes[i]),
+                blocks,
+                0 if jobs is None else int(jobs[i]),
+            )
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drop_machine(self, machine_index: int) -> None:
+        """Machine loss: its replicas disappear; existing groups keep
+        their (now stale) preference until signatures re-register —
+        mirroring the reference, whose preference arcs are pruned on
+        the next task update (removeInvalidPrefResArcs,
+        graph_manager.go:766-790). We prune eagerly instead: any group
+        preferring the machine loses that column."""
+        self.blocks.drop_machine(machine_index)
+        self.pref_w[:, machine_index] = PREF_NONE
+
+    def bump_wait(self, backlog_per_group: np.ndarray) -> None:
+        """Age the escape cost of groups that still have unscheduled
+        tasks (the starvation bound, at group granularity). Call with
+        the per-group backlog derived from fetched state — outside the
+        timed region, at the caller's binding-readback cadence."""
+        waited = np.asarray(backlog_per_group) > 0
+        self.wait_rounds[waited] += 1
+        self.wait_rounds[~waited] = 0
+
+    def effective_u(self) -> np.ndarray:
+        return self.u + self.wait_cost_per_round * self.wait_rounds
+
+    # -- device sync -------------------------------------------------------
+
+    def sync(self, cluster) -> None:
+        """Push the current table to a DeviceBulkCluster (group mode)."""
+        cluster.set_groups(
+            cls=self.cls,
+            job=self.job,
+            e=self.e,
+            u=self.effective_u(),
+            pref_w=self.pref_w,
+        )

@@ -52,27 +52,48 @@ def resolve_pallas() -> Tuple[bool, bool]:
     return jax.default_backend() == "tpu", False
 
 
+#: the fused kernel holds the whole solve state in VMEM; XLA's scoped
+#: vmem limit for custom calls is 16 MiB (measured: a [512, 1024] i32
+#: instance wants 21.33M against a 16.00M limit), and the kernel's live
+#: set is ~10 [C, Mp] i32 tiles across a superstep (wS/U/y +
+#: push/relabel temps). Beyond the budget the XLA phase loop
+#: (HBM-resident state, fused per superstep) is the correct dispatch —
+#: for many-row instances (hundreds of groups) its per-superstep HBM
+#: traffic amortizes fine, and the kernel's VMEM-residency win matters
+#: most exactly where instances are small.
+_PALLAS_VMEM_BUDGET_BYTES = 15 << 20
+_PALLAS_LIVE_TILES = 10
+
+
 def transport_solve(
     wS, supply, col_cap, eps_init, pm0=None, *,
-    alpha: int = 8, max_supersteps: int = 20_000,
+    alpha: int = 8, max_supersteps: int = 20_000, refine_waves: int = 0,
 ):
     """The layered-transport solve behind the mode switch: the fused
     Pallas kernel or the XLA phase loop, one call site for both.
     pm0 optionally warm-starts machine prices (carried across rounds).
+    refine_waves > 0 enables price refinement between eps phases (see
+    solver/layered.py _price_refine) in both implementations.
     Returns (y, pm, steps, converged); traceable inside jit/scan."""
     use_pallas, interpret = resolve_pallas()
+    if use_pallas and not interpret:
+        C, Mp = wS.shape
+        if _PALLAS_LIVE_TILES * C * Mp * 4 > _PALLAS_VMEM_BUDGET_BYTES:
+            use_pallas = False  # state would not fit VMEM-resident
     if use_pallas:
         from .transport_pallas import transport_loop_pallas
 
         return transport_loop_pallas(
             wS, supply, col_cap, eps_init, pm0,
             alpha=alpha, max_supersteps=max_supersteps, interpret=interpret,
+            refine_waves=refine_waves,
         )
     from ..solver.layered import _solve_transport
 
     return _solve_transport(
         wS, supply, col_cap, eps_init, pm0,
         alpha=alpha, max_supersteps=max_supersteps,
+        refine_waves=refine_waves,
     )
 
 

@@ -60,6 +60,7 @@ def _transport_kernel(
     wS_ref, supply_ref, colcap_ref, eps_ref, pminit_ref,
     y_ref, pm_ref, steps_ref, conv_ref,
     *, C: int, Mp: int, alpha: int, max_supersteps: int,
+    refine_waves: int = 0,
 ):
     i32 = jnp.int32
     wS = wS_ref[:]                       # [C, Mp]
@@ -94,6 +95,40 @@ def _transport_kernel(
         y2 = jnp.where(rcf < 0, U, jnp.where(rcf > 0, i32(0), y))
         rcs = pm - psink
         z2 = jnp.where(rcs < 0, col_cap, jnp.where(rcs > 0, i32(0), z))
+        return y2, z2
+
+    def price_refine(y, z, pr, pm, psink, eps):
+        """Price refinement between eps phases (solver/layered.py
+        _price_refine): lower potentials toward eps-optimality of the
+        CURRENT flow so the following partial saturate floods only the
+        few still-violating arcs. min-reductions and selects only — no
+        cumsum/sort, so it lowers cleanly in Pallas TPU."""
+        def body(_, state):
+            pr, pm, psink = state
+            bound_m = jnp.min(
+                jnp.where(U - y > 0, wS + pr + eps, _BIG), axis=0,
+                keepdims=True,
+            )
+            pm2 = jnp.maximum(jnp.minimum(pm, bound_m), -_BIG_D)
+            pm2 = jnp.minimum(pm2, jnp.where(z > 0, psink + eps, _BIG))
+            bound_r = jnp.min(
+                jnp.where(y > 0, pm2 - wS + eps, _BIG), axis=1,
+                keepdims=True,
+            )
+            pr2 = jnp.maximum(jnp.minimum(pr, bound_r), -_BIG_D)
+            bound_s = jnp.min(
+                jnp.where(col_cap - z > 0, pm2 + eps, _BIG)
+            ).reshape(1, 1)
+            psink2 = jnp.maximum(jnp.minimum(psink, bound_s), -_BIG_D)
+            return pr2, pm2, psink2
+
+        return lax.fori_loop(0, refine_waves, body, (pr, pm, psink))
+
+    def saturate_eps(y, z, pr, pm, psink, eps):
+        rcf = wS + pr - pm
+        y2 = jnp.where(rcf < -eps, U, jnp.where(rcf > eps, i32(0), y))
+        rcs = pm - psink
+        z2 = jnp.where(rcs < -eps, col_cap, jnp.where(rcs > eps, i32(0), z))
         return y2, z2
 
     def superstep(y, z, pr, pm, psink, eps):
@@ -159,11 +194,18 @@ def _transport_kernel(
         def next_phase(_):
             finished = eps <= 1
             new_eps = jnp.maximum(i32(1), eps // alpha)
-            y2, z2 = saturate(y, z, pr, pm, psink)
+            if refine_waves:
+                pr2, pm2, psink2 = price_refine(y, z, pr, pm, psink, new_eps)
+                y2, z2 = saturate_eps(y, z, pr2, pm2, psink2, new_eps)
+            else:
+                pr2, pm2, psink2 = pr, pm, psink
+                y2, z2 = saturate(y, z, pr, pm, psink)
             return (
                 jnp.where(finished, y, y2),
                 jnp.where(finished, z, z2),
-                pr, pm, psink,
+                jnp.where(finished, pr, pr2),
+                jnp.where(finished, pm, pm2),
+                jnp.where(finished, psink, psink2),
                 jnp.where(finished, eps, new_eps),
                 steps,
                 finished,
@@ -189,13 +231,15 @@ def _transport_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("alpha", "max_supersteps", "interpret")
+    jax.jit,
+    static_argnames=("alpha", "max_supersteps", "interpret", "refine_waves"),
 )
 def transport_loop_pallas(
     wS, supply, col_cap, eps_init, pm0=None,
     alpha: int = 8,
     max_supersteps: int = 20_000,
     interpret: bool = False,
+    refine_waves: int = 0,
 ):
     """Drop-in twin of solver/layered.py `_transport_loop`'s public
     result (y, pm, steps, converged), one fused kernel per solve.
@@ -212,6 +256,7 @@ def transport_loop_pallas(
         functools.partial(
             _transport_kernel,
             C=C, Mp=Mp, alpha=alpha, max_supersteps=max_supersteps,
+            refine_waves=refine_waves,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((C, Mp), jnp.int32),

@@ -42,6 +42,7 @@ from ..solver.layered import (
     COST_SCALE_LIMIT,
     choose_eps0,
     pad_geometry,
+    split_grants_by_class,
     transport_fori,
     transport_fori_tiered,
     validate_alpha,
@@ -56,6 +57,34 @@ class DeviceClusterState(NamedTuple):
     pu: jnp.ndarray  # int32[Tcap]; PU index or -1
     pu_running: jnp.ndarray  # int32[num_pus]
     machine_enabled: jnp.ndarray  # bool[M]
+    #: interchangeability group per task (group mode; all-zero otherwise)
+    grp: jnp.ndarray  # int32[Tcap]
+
+
+class GroupSpec(NamedTuple):
+    """Device-resident group metadata (group mode): row g of the
+    transport is one interchangeability class of tasks — same task
+    class, same escape cost, same per-machine cost profile. This is how
+    per-task preference arcs (graph_manager.go:1229-1264,
+    costmodel/interface.go:105-110 GetTaskPreferenceArcs) ride the
+    dense fast path: tasks sharing a preference signature share a row,
+    and the signature's preferred machines become per-row cost
+    overrides (pref_w) min'd into the class cost row. Arrays live on
+    device and are passed as traced args, so the host can update them
+    (new signatures, wait-cost aging) without recompiling the round."""
+
+    cls: jnp.ndarray  # int32[G] class of each group (census/cost row)
+    job: jnp.ndarray  # int32[G] job of each group (bookkeeping)
+    e: jnp.ndarray  # int32[G] task->EC route base cost (per group)
+    u: jnp.ndarray  # int32[G] escape (unsched) cost per group
+    pref_w: jnp.ndarray  # int32[G, M] absolute route cost overrides;
+    #                      PREF_NONE where the group has no preference
+
+
+#: pref_w fill for "no preference": large enough to never win the min
+#: against any guarded route cost, small enough that min() arithmetic
+#: cannot overflow int32
+PREF_NONE = 1 << 30
 
 
 class DeviceBulkCluster:
@@ -78,6 +107,8 @@ class DeviceBulkCluster:
         job_unsched_cost: Optional[np.ndarray] = None,
         preemption: bool = False,
         continuation_discount: int = 1,
+        num_groups: int = 0,
+        active_groups_cap: int = 256,
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -104,6 +135,24 @@ class DeviceBulkCluster:
         job_unsched_cost = self.job_unsched_cost  # normalized array/None
         self.per_job = job_unsched_cost is not None
         self.G = num_jobs * num_task_classes if self.per_job else num_task_classes
+        # Group mode: rows are caller-defined interchangeability groups
+        # (see GroupSpec) instead of classes / (job, class) pairs. The
+        # group axis is static (capacity num_groups); metadata arrives
+        # as traced device arrays so signatures can be registered and
+        # escape costs aged between rounds without recompiling.
+        self.grouped = num_groups > 0
+        if self.grouped:
+            if self.per_job:
+                raise ValueError(
+                    "num_groups and job_unsched_cost are exclusive: group "
+                    "escape costs (GroupSpec.u) subsume per-job unsched costs"
+                )
+            self.G = int(num_groups)
+        if active_groups_cap < 1:
+            raise ValueError("active_groups_cap must be >= 1")
+        # rows the COMPACTED grouped solve can hold (rounds whose
+        # backlog touches more groups take the full-width solve)
+        self.active_groups_cap = int(min(active_groups_cap, max(self.G, 1)))
         # Preemption (keep-arcs semantics, graph_manager.go:855-888):
         # every round's solve reconsiders PLACED tasks too — staying on
         # the current machine is discounted by `continuation_discount`
@@ -133,10 +182,15 @@ class DeviceBulkCluster:
         self.decode_width = None if decode_width is None else int(decode_width)
         # Degenerate = every group shares one cost row (no class cost
         # model, and no per-job cost spread): the solve collapses to
-        # the exact closed form regardless of G.
-        self.class_degenerate = class_cost_fn is None and (
-            job_unsched_cost is None
-            or bool((job_unsched_cost == job_unsched_cost[0]).all())
+        # the exact closed form regardless of G. Group mode is assumed
+        # heterogeneous (preference overrides differentiate rows).
+        self.class_degenerate = (
+            not self.grouped
+            and class_cost_fn is None
+            and (
+                job_unsched_cost is None
+                or bool((job_unsched_cost == job_unsched_cost[0]).all())
+            )
         )
         # A positive continuation discount makes cells residency-
         # dependent, so the degenerate collapse only applies to
@@ -163,7 +217,17 @@ class DeviceBulkCluster:
             pu=jnp.full(self.Tcap, -1, jnp.int32),
             pu_running=jnp.zeros(self.num_pus, jnp.int32),
             machine_enabled=jnp.ones(self.M, jnp.bool_),
+            grp=jnp.zeros(self.Tcap, jnp.int32),
         )
+        # Benign defaults until set_groups: every group is class 0 /
+        # job 0 at the scalar costs with no preferences.
+        self.groups = GroupSpec(
+            cls=jnp.zeros(self.G, jnp.int32),
+            job=jnp.zeros(self.G, jnp.int32),
+            e=jnp.full(self.G, self.ec_cost, jnp.int32),
+            u=jnp.full(self.G, self.unsched_cost, jnp.int32),
+            pref_w=jnp.full((self.G, self.M), PREF_NONE, jnp.int32),
+        ) if self.grouped else None
         self._build_programs()
         self.last_stats: Optional[dict] = None
         self.last_admitted = None  # device i32 from the latest add_tasks
@@ -183,6 +247,8 @@ class DeviceBulkCluster:
         steady_decode_width = self.decode_width
         i32 = jnp.int32
         per_job, Gn = self.per_job, self.G
+        grouped = self.grouped
+        active_cap = self.active_groups_cap
         class_degenerate = self.class_degenerate
         preempt, discount = self.preemption, self.continuation_discount
         # Per-row (group) escape costs: row g = j*C + c escapes at job
@@ -259,8 +325,76 @@ class DeviceBulkCluster:
             pu_abs = machine * P + pu_in.astype(i32)
             return granted, pu_abs
 
-        def round_core(state: DeviceClusterState, decode_width=None,
-                       window_offset=None):
+        def rank_match_decode_grouped(g_safe, grants_gm, pu_free):
+            """Group-mode twin of rank_match_decode for LARGE group
+            counts: the one-hot path's [W, Gn] x [Gn, M] matmuls scale
+            as W*Gn*M MACs — prohibitive at thousands of groups. This
+            variant computes in-group ranks with ONE stable sort and
+            selects each row's cumulative-grant rows by gather (two
+            [W, M] ROW gathers — rows are lane-contiguous slices, the
+            fast gather direction on TPU). Same output contract as
+            rank_match_decode: (granted bool[W], pu_abs i32[W])."""
+            W = g_safe.shape[0]
+            hi = jax.lax.Precision.HIGHEST
+            part = g_safe < i32(Gn)
+            # in-group exclusive rank via one stable sort (same trick
+            # as the preempt decode's per-cell resident ranks)
+            order = jnp.argsort(g_safe, stable=True)
+            counts = jnp.zeros(Gn + 1, i32).at[g_safe].add(1)
+            starts = jnp.cumsum(counts) - counts
+            rank_sorted = jnp.arange(W, dtype=i32) - starts[g_safe[order]]
+            rank = jnp.zeros(W, i32).at[order].set(rank_sorted)
+            quota = jnp.sum(grants_gm, axis=1)  # [Gn]
+            quota_t = jnp.concatenate([quota, jnp.zeros(1, i32)])[g_safe]
+            granted = part & (rank < quota_t)
+
+            # group-row -> machine via the row's cumulative grants
+            g_clip = jnp.clip(g_safe, 0, Gn - 1)
+            cum_t = jnp.cumsum(grants_gm, axis=1)[g_clip]  # [W, M]
+            offs_t = (jnp.cumsum(grants_gm, axis=0) - grants_gm)[g_clip]
+            cmp = cum_t <= rank[:, None]  # [W, M]
+            machine = jnp.sum(cmp, axis=1, dtype=i32)
+            excl_at = jnp.max(jnp.where(cmp, cum_t, i32(0)), axis=1)
+            cols = jnp.arange(M, dtype=i32)[None, :]
+            oh = machine[:, None] == cols  # [W, M]
+            off_at = jnp.sum(jnp.where(oh, offs_t, i32(0)), axis=1)
+            slot = off_at + (rank - excl_at)  # within-machine slot
+
+            # split each machine's grant across its PUs in slot order
+            t_m = jnp.sum(grants_gm, axis=0)
+            pf2 = pu_free.reshape(M, P)
+            exclg = jnp.cumsum(pf2, axis=1) - pf2
+            grants_pu = jnp.clip(t_m[:, None] - exclg, 0, pf2)
+            cumg = jnp.cumsum(grants_pu, axis=1).astype(jnp.float32)
+            cg_at = jnp.einsum(
+                "tm,mp->tp", oh.astype(jnp.float32), cumg, precision=hi
+            )  # [W, P]
+            pu_in = jnp.sum(cg_at <= slot[:, None].astype(jnp.float32), axis=1)
+            pu_abs = machine * P + pu_in.astype(i32)
+            return granted, pu_abs
+
+        def group_costs(gspec: GroupSpec, cost_cm):
+            """[G, M] effective per-unit place cost and shifted solve
+            matrix for group mode. Route via the class EC costs
+            e_g + cost[cls_g, m]; a preference override (pref_w) wins
+            where cheaper — exactly min(EC route, preference arc), the
+            two parallel paths a task has in the reference graph
+            (updateTaskNode wiring, graph_manager.go:1183-1264)."""
+            if cost_fn is None:
+                route = jnp.broadcast_to(gspec.e[:, None], (Gn, M))
+            else:
+                # exact integer row gather — costs are NOT counts, so
+                # the one-hot f32 matmul trick (which silently rounds
+                # values >= 2^24 even at HIGHEST) is not usable here;
+                # G row gathers from a [C, M] table are cheap
+                cost_gm = cost_cm[jnp.clip(gspec.cls, 0, C - 1)]
+                route = cost_gm + gspec.e[:, None]
+            cost_eff = jnp.minimum(route, gspec.pref_w)
+            w = cost_eff - gspec.u[:, None]
+            return cost_eff, w
+
+        def round_core(state: DeviceClusterState, gspec=None,
+                       decode_width=None, window_offset=None):
             """One scheduling round. decode_width (static) bounds the
             decode to a compacted window of that many unplaced rows —
             the admission-batch bound (the reference bounds per-round
@@ -288,6 +422,7 @@ class DeviceBulkCluster:
                 valid = unplaced
                 cls_w = state.cls
                 job_w = state.job
+                grp_w = state.grp
             else:
                 W = int(decode_width)
                 # compact W unplaced rows into the window: select the
@@ -316,8 +451,14 @@ class DeviceBulkCluster:
                 job_w = jnp.where(
                     valid, state.job[jnp.clip(idx, 0, Tcap - 1)], i32(0)
                 )
+                grp_w = jnp.where(
+                    valid, state.grp[jnp.clip(idx, 0, Tcap - 1)], i32(Gn)
+                )
             # group index per window row; sentinel Gn for invalid rows
-            g_w = (job_w * i32(C) + cls_w) if per_job else cls_w
+            if grouped:
+                g_w = grp_w
+            else:
+                g_w = (job_w * i32(C) + cls_w) if per_job else cls_w
             g_safe = jnp.where(valid, g_w, i32(Gn))
             supply = jnp.zeros(Gn + 1, i32).at[g_safe].add(1)[:Gn]
             total = jnp.sum(supply)
@@ -326,10 +467,14 @@ class DeviceBulkCluster:
                 cost_cm = cost_fn(census_of(state)).astype(i32)
             else:
                 cost_cm = jnp.zeros((C, M), i32)
-            # group rows: g = j*C + c carries class c's cost row and
-            # job j's escape cost (the per-job unsched differentiation)
-            cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
-            w = cost_gm + i32(e_cost) - u_row[:, None]
+            if grouped:
+                cost_eff, w = group_costs(gspec, cost_cm)
+            else:
+                # group rows: g = j*C + c carries class c's cost row and
+                # job j's escape cost (the per-job unsched differentiation)
+                cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
+                cost_eff = cost_gm + i32(e_cost)
+                w = cost_eff - u_row[:, None]
             # int32 headroom guard: the host solver raises OverflowError
             # for the same condition (solver/layered.py solve_layered);
             # in a jitted round we can only flag it — surfaced in stats
@@ -353,26 +498,141 @@ class DeviceBulkCluster:
             # pathology — measured 20x SLOWER (9ms -> 197ms/round on the
             # CoCo 50k config) than cold tightening, which re-derives
             # prices from the cost structure each round.
-            # eps0 = n_scale/16: measured ~5x fewer supersteps than
-            # starting at one original cost unit on contended
-            # interference-model instances, still exactly optimal (any
-            # eps0 is valid off tightened potentials; the in-graph
-            # fallback to the full schedule covers pathologies).
-            # Oversubscribed rounds (backlog > free slots) switch to
-            # the full-range start — see choose_eps0.
-            eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
-            y, _pm, solve_steps, converged = transport_fori(
-                wS, supply, col_cap, supersteps,
-                alpha=alpha,
-                eps0=choose_eps0(
-                    n_scale, eps_full, total, jnp.sum(machine_free)
-                ),
-                class_degenerate=class_degenerate,
-            )
+            if not grouped:
+                # eps0 = n_scale/16: measured ~5x fewer supersteps than
+                # starting at one original cost unit on contended
+                # interference-model instances, still exactly optimal
+                # (any eps0 is valid off tightened potentials; the
+                # in-graph fallback to the full schedule covers
+                # pathologies). Oversubscribed rounds (backlog > free
+                # slots) switch to the full-range start — choose_eps0.
+                eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
+                y, _pm, solve_steps, converged = transport_fori(
+                    wS, supply, col_cap, supersteps,
+                    alpha=alpha,
+                    eps0=choose_eps0(
+                        n_scale, eps_full, total, jnp.sum(machine_free)
+                    ),
+                    class_degenerate=class_degenerate,
+                )
+            else:
+                # Grouped solves: (a) EXACT two-stage decomposition for
+                # the locality structure (row-constant ground + sparse
+                # preference overrides — cost_fn None): with every
+                # row's ground profitable and the round not
+                # oversubscribed, all units place, so total cost =
+                # sum(ground_g * supply_g) (a constant) minus the
+                # discount recovered on pref cells; stage 1 maximizes
+                # discounts on the SPARSE pref cells alone, stage 2
+                # spreads leftovers in closed form. The one-shot dense
+                # solve herds on the uniform ground cells instead —
+                # measured 27k-43k supersteps on real steady rounds.
+                # (b) Row COMPACTION: steady backlogs touch ~a hundred
+                # of the G groups; compacting to the active rows cuts
+                # per-superstep cost ~G/active and keeps the instance
+                # inside the fused kernel's VMEM budget.
+                # (c) alpha=2 + price refinement: fine phases whose
+                # flows carry over (only violations re-flood) resolve
+                # the pref-contention price fights in ~2.7k supersteps
+                # where coarse re-flooding phases took ~35k.
+                ground = gspec.e - gspec.u  # [G] route - escape
+                can_two_stage = cost_fn is None
+                if can_two_stage:
+                    D = jnp.maximum(ground[:, None] - w, i32(0))  # [G, M]
+                    w1 = jnp.where(D > 0, -D, i32(1))
+                    wS1 = jnp.zeros((Gn, Mp), i32).at[:, :M].set(
+                        w1 * i32(n_scale)
+                    )
+                else:
+                    wS1 = wS  # unused
+
+                def grouped_solve(wS_x, wS1_x, supply_x, ground_x):
+                    """Solve one grouped instance (row count from the
+                    input shapes); returns (y, steps, converged)."""
+                    total_x = jnp.sum(supply_x)
+                    eps_full_x = jnp.maximum(jnp.max(jnp.abs(wS_x)), i32(1))
+
+                    def solve_full(_):
+                        y_f, _pmf, s_f, c_f = transport_fori(
+                            wS_x, supply_x, col_cap, supersteps,
+                            alpha=2, refine_waves=8,
+                            eps0=choose_eps0(
+                                n_scale, eps_full_x, total_x,
+                                jnp.sum(machine_free),
+                            ),
+                        )
+                        return y_f, s_f, c_f
+
+                    if not can_two_stage:
+                        return solve_full(None)
+
+                    def solve_two_stage(_):
+                        # eps0=1 finishes the sparse matching in tens
+                        # of waves when pref capacity suffices, but
+                        # stalls on deep descents when residents block
+                        # the preferred machines — bound it and fall
+                        # back to the refined full range
+                        y1, _pm1, s1, conv1 = transport_fori(
+                            wS1_x, supply_x, col_cap, supersteps,
+                            alpha=2, refine_waves=8,
+                            eps0=i32(1), eps0_budget=256,
+                        )
+                        y1r = y1[:, :M]
+                        left = supply_x - jnp.sum(y1r, axis=1).astype(i32)
+                        rem = machine_free - jnp.sum(y1r, axis=0).astype(i32)
+                        excl = jnp.cumsum(rem) - rem
+                        grants_m = jnp.clip(jnp.sum(left) - excl, 0, rem)
+                        y2 = split_grants_by_class(grants_m, left)
+                        y_out = y1.at[:, :M].add(y2.astype(i32))
+                        # escape column: anything beyond real capacity
+                        y_out = y_out.at[:, Mp - 1].set(
+                            supply_x
+                            - jnp.sum(y_out[:, :M], axis=1).astype(i32)
+                        )
+                        return y_out, s1, conv1
+
+                    two_stage_ok = (
+                        (total_x <= jnp.sum(machine_free))
+                        & jnp.all((ground_x < 0) | (supply_x == 0))
+                    )
+                    return lax.cond(
+                        two_stage_ok, solve_two_stage, solve_full,
+                        operand=None,
+                    )
+
+                Gc = active_cap
+                if Gc < Gn:
+                    act = supply > 0
+                    order = jnp.argsort(~act, stable=True)
+                    sel = order[:Gc]
+                    valid_c = act[sel]
+                    fits = jnp.sum(act.astype(i32)) <= i32(Gc)
+
+                    def compact_path(_):
+                        sup_c = jnp.where(valid_c, supply[sel], i32(0))
+                        y_c, s_c, c_c = grouped_solve(
+                            wS[sel], wS1[sel], sup_c, ground[sel]
+                        )
+                        y_f = jnp.zeros((Gn, Mp), i32).at[sel].add(
+                            jnp.where(valid_c[:, None], y_c, i32(0))
+                        )
+                        return y_f, s_c, c_c
+
+                    def full_path(_):
+                        return grouped_solve(wS, wS1, supply, ground)
+
+                    y, solve_steps, converged = lax.cond(
+                        fits, compact_path, full_path, operand=None
+                    )
+                else:
+                    y, solve_steps, converged = grouped_solve(
+                        wS, wS1, supply, ground
+                    )
             y_real = y[:, :M]
 
             # ---- decode: rank-match placed tasks to machine grants ----
-            placed_w, pu_abs = rank_match_decode(g_safe, y_real, pu_free)
+            decode = rank_match_decode_grouped if grouped else rank_match_decode
+            placed_w, pu_abs = decode(g_safe, y_real, pu_free)
 
             if idx is None:
                 # identity window: elementwise select, no scatter
@@ -393,19 +653,23 @@ class DeviceBulkCluster:
             # unscheduled counts the WHOLE backlog left pending (solver
             # escapes + rows beyond the decode window) — matches the
             # host BulkCluster's num_unsched accounting
-            if per_job:
+            if per_job or grouped:
                 # per-group escape pricing needs the whole-pool backlog
                 # split by group, not just the window's
-                g_all = state.job * i32(C) + state.cls
+                g_all = (
+                    state.grp if grouped
+                    else state.job * i32(C) + state.cls
+                )
                 g_all_safe = jnp.where(unplaced, g_all, i32(Gn))
                 backlog_g = jnp.zeros(Gn + 1, i32).at[g_all_safe].add(1)[:Gn]
                 placed_g = jnp.sum(y_real, axis=1).astype(i32)
-                objective = jnp.sum(u_row * (backlog_g - placed_g)) + jnp.sum(
-                    (cost_gm + i32(e_cost)) * y_real
+                u_g = gspec.u if grouped else u_row
+                objective = jnp.sum(u_g * (backlog_g - placed_g)) + jnp.sum(
+                    cost_eff * y_real
                 )
             else:
                 objective = i32(u_cost) * (backlog - placed_count) + jnp.sum(
-                    (cost_cm + i32(e_cost)) * y_real
+                    cost_eff * y_real
                 )
             stats = {
                 "placed": placed_count,
@@ -421,7 +685,7 @@ class DeviceBulkCluster:
             }
             return state._replace(pu=new_pu, pu_running=pu_running), stats
 
-        def round_core_preempt(state: DeviceClusterState):
+        def round_core_preempt(state: DeviceClusterState, gspec=None):
             """Preemption-on round (keep-arcs semantics, graph_manager.
             go:855-888): every live task re-solves. Staying on the
             current machine is discounted, moving pays full price,
@@ -443,7 +707,10 @@ class DeviceBulkCluster:
             placed = live & (state.pu >= 0)
             cur_pu = jnp.clip(state.pu, 0, num_pus - 1)
             cur_m = jnp.where(placed, cur_pu // P, i32(M))  # sentinel M
-            g_t = (state.job * i32(C) + state.cls) if per_job else state.cls
+            if grouped:
+                g_t = state.grp
+            else:
+                g_t = (state.job * i32(C) + state.cls) if per_job else state.cls
             g_safe = jnp.where(live, g_t, i32(Gn))
             supply = jnp.zeros(Gn + 1, i32).at[g_safe].add(1)[:Gn]
             total = jnp.sum(supply)
@@ -452,8 +719,12 @@ class DeviceBulkCluster:
                 cost_cm = cost_fn(census_of(state)).astype(i32)
             else:
                 cost_cm = jnp.zeros((C, M), i32)
-            cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
-            w = cost_gm + i32(e_cost) - u_row[:, None]
+            if grouped:
+                cost_eff, w = group_costs(gspec, cost_cm)
+            else:
+                cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
+                cost_eff = cost_gm + i32(e_cost)
+                w = cost_eff - u_row[:, None]
             cost_overflow = (
                 jnp.max(jnp.abs(w)) + i32(discount)
             ) >= i32(COST_SCALE_LIMIT // n_scale)
@@ -509,7 +780,8 @@ class DeviceBulkCluster:
             stay_pu = jnp.where(stay, cur_pu, num_pus)
             pu_stay = jnp.zeros(num_pus + 1, i32).at[stay_pu].add(1)[:num_pus]
             pu_free_mv = jnp.where(enabled_pu, i32(S) - pu_stay, i32(0))
-            granted, pu_abs = rank_match_decode(g_mv, rem, pu_free_mv)
+            decode = rank_match_decode_grouped if grouped else rank_match_decode
+            granted, pu_abs = decode(g_mv, rem, pu_free_mv)
 
             new_pu = jnp.where(
                 stay, state.pu, jnp.where(granted, pu_abs, i32(-1))
@@ -519,12 +791,14 @@ class DeviceBulkCluster:
             pu_running = jnp.zeros(num_pus + 1, i32).at[pu_idx].add(1)[:num_pus]
 
             placed_total = jnp.sum(y_real, dtype=i32)
-            # objective: placements at (cost + e), retained residents
-            # rebated by the discount, escapes at the group unsched cost
+            # objective: placements at the effective route cost,
+            # retained residents rebated by the discount, escapes at
+            # the group unsched cost
+            u_g = gspec.u if grouped else u_row
             objective = (
-                jnp.sum((cost_gm + i32(e_cost)) * y_real)
+                jnp.sum(cost_eff * y_real)
                 - i32(discount) * jnp.sum(retained)
-                + jnp.sum(u_row * (supply - jnp.sum(y_real, axis=1)))
+                + jnp.sum(u_g * (supply - jnp.sum(y_real, axis=1)))
             )
             stats = {
                 "placed": jnp.sum(granted & ~placed, dtype=i32),
@@ -539,12 +813,12 @@ class DeviceBulkCluster:
             }
             return state._replace(pu=new_pu, pu_running=pu_running), stats
 
-        def admit(state: DeviceClusterState, jobs, classes, count):
+        def admit(state: DeviceClusterState, jobs, classes, groups, count):
             """Occupy the first `count` free rows with the first `count`
-            entries of (jobs, classes). Returns (state, admitted):
-            admitted < count when the task pool is exhausted — the host
-            BulkCluster raises for this; here the shortfall is reported
-            so add_tasks can check it after fetch."""
+            entries of (jobs, classes, groups). Returns (state,
+            admitted): admitted < count when the task pool is exhausted
+            — the host BulkCluster raises for this; here the shortfall
+            is reported so add_tasks can check it after fetch."""
             free_rank = jnp.cumsum(~state.live) - 1  # rank among free rows
             newmask = ~state.live & (free_rank < count)
             src_idx = jnp.clip(free_rank, 0, Tcap - 1)
@@ -553,6 +827,7 @@ class DeviceBulkCluster:
                 live=state.live | newmask,
                 cls=jnp.where(newmask, classes[src_idx].astype(i32), state.cls),
                 job=jnp.where(newmask, jobs[src_idx].astype(i32), state.job),
+                grp=jnp.where(newmask, groups[src_idx].astype(i32), state.grp),
                 pu=jnp.where(newmask, i32(-1), state.pu),
             ), admitted
 
@@ -595,12 +870,15 @@ class DeviceBulkCluster:
                 pu_running=pu_running,
             )
 
-        def steady_round(state: DeviceClusterState, key, churn_prob, arrivals):
+        def steady_round(state: DeviceClusterState, gspec, key, churn_prob,
+                         arrivals):
             """One benchmark round: complete ~churn_prob of running
-            tasks, admit `arrivals` new ones (random job/class), then
-            schedule. Entirely on device so rounds chain without host
-            sync — the incremental re-solve regime Flowlessly's daemon
-            mode serves in the reference (placement/solver.go:60-90)."""
+            tasks, admit `arrivals` new ones (random job/class — or a
+            random GROUP in group mode, with class/job gathered from
+            the group metadata), then schedule. Entirely on device so
+            rounds chain without host sync — the incremental re-solve
+            regime Flowlessly's daemon mode serves in the reference
+            (placement/solver.go:60-90)."""
             k1, k2, k3, k4 = jax.random.split(key, 4)
             placed = state.live & (state.pu >= 0)
             done = placed & (
@@ -615,18 +893,19 @@ class DeviceBulkCluster:
             )
             free_rank = jnp.cumsum(~state.live) - 1
             newmask = ~state.live & (free_rank < arrivals)
+            if grouped:
+                new_grp = jax.random.randint(k2, (Tcap,), 0, Gn)
+                new_cls = gspec.cls[new_grp]
+                new_job = gspec.job[new_grp]
+            else:
+                new_grp = jnp.zeros(Tcap, i32)
+                new_cls = jax.random.randint(k2, (Tcap,), 0, C)
+                new_job = jax.random.randint(k3, (Tcap,), 0, J)
             state = state._replace(
                 live=state.live | newmask,
-                cls=jnp.where(
-                    newmask,
-                    jax.random.randint(k2, (Tcap,), 0, C),
-                    state.cls,
-                ),
-                job=jnp.where(
-                    newmask,
-                    jax.random.randint(k3, (Tcap,), 0, J),
-                    state.job,
-                ),
+                cls=jnp.where(newmask, new_cls, state.cls),
+                job=jnp.where(newmask, new_job, state.job),
+                grp=jnp.where(newmask, new_grp, state.grp),
                 pu=jnp.where(newmask, i32(-1), state.pu),
             )
             admitted = jnp.sum(newmask, dtype=i32)
@@ -637,10 +916,11 @@ class DeviceBulkCluster:
             # Preemption mode always decodes full-width (placed tasks
             # are in play every round).
             if preempt:
-                state, stats = round_core_preempt(state)
+                state, stats = round_core_preempt(state, gspec)
             else:
                 state, stats = round_core(
                     state,
+                    gspec,
                     decode_width=steady_decode_width,
                     window_offset=jax.random.randint(k4, (), 0, 1 << 30),
                 )
@@ -648,40 +928,107 @@ class DeviceBulkCluster:
             stats["admitted"] = admitted
             return state, stats
 
-        self._round_jit = jax.jit(round_core_preempt if preempt else round_core)
+        core = round_core_preempt if preempt else round_core
+        self._round_jit = jax.jit(core)
         self._admit_jit = jax.jit(admit)
         self._complete_jit = jax.jit(complete)
         self._set_machine_jit = jax.jit(set_machine, static_argnums=(2,))
 
-        def steady_scan(state, key0, churn_prob, arrivals, num_rounds):
+        def steady_scan(state, gspec, key0, churn_prob, arrivals, num_rounds):
             keys = jax.random.split(key0, num_rounds)
 
             def body(s, k):
-                return steady_round(s, k, churn_prob, arrivals)
+                return steady_round(s, gspec, k, churn_prob, arrivals)
 
             return lax.scan(body, state, keys)
 
-        self._steady_scan_jit = jax.jit(steady_scan, static_argnums=(3, 4))
+        self._steady_scan_jit = jax.jit(steady_scan, static_argnums=(4, 5))
 
     # ------------------------------------------------------------------
     # host API
     # ------------------------------------------------------------------
 
-    def add_tasks(self, count, job_ids=None, classes=None) -> None:
+    def add_tasks(self, count, job_ids=None, classes=None, groups=None) -> None:
         """Admit up to `count` tasks. The admitted count is kept on
         device in ``last_admitted`` (fetching it mid-run would poison
         dispatch latency on tunneled TPUs — see bench.py); callers that
         need the host BulkCluster's pool-exhausted error should check
         ``int(jax.device_get(self.last_admitted)) == count`` at a safe
-        point."""
+        point. In group mode, `groups` assigns each task its
+        interchangeability group (see GroupSpec / set_groups)."""
         jobs = np.zeros(self.Tcap, np.int32)
         cls = np.zeros(self.Tcap, np.int32)
+        grp = np.zeros(self.Tcap, np.int32)
         if job_ids is not None:
             jobs[: len(job_ids)] = job_ids
         if classes is not None:
             cls[: len(classes)] = classes
+        if groups is not None:
+            if not self.grouped:
+                raise ValueError("groups requires num_groups > 0")
+            g = np.asarray(groups, np.int32)
+            if ((g < 0) | (g >= self.G)).any():
+                raise ValueError(
+                    f"task group out of range [0, {self.G}): "
+                    f"{g.min()}..{g.max()}"
+                )
+            grp[: len(g)] = g
         self.state, self.last_admitted = self._admit_jit(
-            self.state, jnp.asarray(jobs), jnp.asarray(cls), jnp.int32(count)
+            self.state, jnp.asarray(jobs), jnp.asarray(cls),
+            jnp.asarray(grp), jnp.int32(count)
+        )
+
+    def set_groups(
+        self, cls=None, job=None, e=None, u=None, pref_w=None
+    ) -> None:
+        """Upload group metadata (group mode). Each argument updates
+        the corresponding GroupSpec field ([G] arrays; pref_w [G, M],
+        PREF_NONE = no preference); omitted fields keep their current
+        values. Host -> device only — no recompilation (the arrays are
+        traced arguments of the round programs)."""
+        if not self.grouped:
+            raise ValueError("set_groups requires num_groups > 0")
+        limit = COST_SCALE_LIMIT // self.n_scale
+
+        def _vec(name, val, cur, index_range=None):
+            if val is None:
+                return cur
+            a = np.asarray(val, np.int64)
+            if a.shape != (self.G,):
+                raise ValueError(f"{name} must have shape ({self.G},), got {a.shape}")
+            if index_range is not None:
+                if a.size and ((a < 0) | (a >= index_range)).any():
+                    raise ValueError(
+                        f"{name} out of range [0, {index_range}): "
+                        f"{a.min()}..{a.max()}"
+                    )
+            elif a.size and np.abs(a).max() >= limit:
+                raise OverflowError(
+                    f"{name} magnitude {np.abs(a).max()} exceeds the "
+                    f"scaled-cost limit {limit}"
+                )
+            return jnp.asarray(a.astype(np.int32))
+
+        pw = self.groups.pref_w
+        if pref_w is not None:
+            a = np.asarray(pref_w, np.int64)
+            if a.shape != (self.G, self.M):
+                raise ValueError(
+                    f"pref_w must have shape ({self.G}, {self.M}), got {a.shape}"
+                )
+            real = a[a < PREF_NONE]
+            if real.size and np.abs(real).max() >= limit:
+                raise OverflowError(
+                    f"pref_w magnitude {np.abs(real).max()} exceeds the "
+                    f"scaled-cost limit {limit}"
+                )
+            pw = jnp.asarray(np.minimum(a, PREF_NONE).astype(np.int32))
+        self.groups = GroupSpec(
+            cls=_vec("cls", cls, self.groups.cls, index_range=self.C),
+            job=_vec("job", job, self.groups.job, index_range=self.J),
+            e=_vec("e", e, self.groups.e),
+            u=_vec("u", u, self.groups.u),
+            pref_w=pw,
         )
 
     def complete_tasks(self, rows) -> None:
@@ -700,7 +1047,7 @@ class DeviceBulkCluster:
         """One scheduling round; returns un-fetched device stats (call
         fetch_stats() to materialize — the analogue of the reference's
         binding push AFTER the timed region)."""
-        self.state, stats = self._round_jit(self.state)
+        self.state, stats = self._round_jit(self.state, self.groups)
         self.last_stats = stats
         return stats
 
@@ -711,6 +1058,7 @@ class DeviceBulkCluster:
         stacked stats (device arrays, un-fetched)."""
         self.state, stats = self._steady_scan_jit(
             self.state,
+            self.groups,
             jax.random.PRNGKey(seed),
             jnp.float32(churn_prob),
             int(arrivals),

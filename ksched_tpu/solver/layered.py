@@ -236,6 +236,58 @@ def transport_saturate(wS, U, col_cap, y, z, pr, pm, psink):
     return y2, z2
 
 
+def transport_saturate_eps(wS, U, col_cap, y, z, pr, pm, psink, eps):
+    """Partial saturate: reset ONLY the arcs violating eps-optimality
+    (|reduced cost| beyond eps on a residual direction), keeping the
+    rest of the flow. With eps=0 this is transport_saturate. Used with
+    price refinement, where most of the converged flow already
+    satisfies the next phase's eps and re-flooding it would re-fight
+    every contended column from scratch."""
+    i32 = jnp.int32
+    rcf = wS + pr[:, None] - pm[None, :]
+    y2 = jnp.where(rcf < -eps, U, jnp.where(rcf > eps, i32(0), y))
+    rcs = pm - psink
+    z2 = jnp.where(rcs < -eps, col_cap, jnp.where(rcs > eps, i32(0), z))
+    return y2, z2
+
+
+def _price_refine(wS, U, col_cap, y, z, pr, pm, psink, eps, waves: int):
+    """Price refinement (the classic cost-scaling speedup, cf. CS2's
+    price updates): `waves` synchronous Bellman-Ford relaxations that
+    LOWER potentials toward eps-optimality of the CURRENT flow before
+    the next phase. Each eps-optimality constraint has the form
+    potential <= other + slack over a residual arc; relaxing monotonely
+    downward converges in graph-diameter waves on this shallow layered
+    structure. The wave count is bounded (a residual cycle more
+    negative than the slack would otherwise descend forever — possible
+    while eps shrinks); whatever violations remain are cleaned by
+    transport_saturate_eps, so optimality never depends on the refit
+    finishing."""
+    big = jnp.int32(_BIG)
+    big_d = jnp.int32(_BIG_D)
+
+    def body(_, state):
+        pr, pm, psink = state
+        # fwd residual row->col (U-y>0): pm <= wS + pr + eps
+        bound_m = jnp.min(
+            jnp.where(U - y > 0, wS + pr[:, None] + eps, big), axis=0
+        )
+        pm2 = jnp.maximum(jnp.minimum(pm, bound_m), -big_d)
+        # sink->col residual (z>0): pm <= psink + eps
+        pm2 = jnp.minimum(pm2, jnp.where(z > 0, psink + eps, big))
+        # bwd residual col->row (y>0): pr <= pm - wS + eps
+        bound_r = jnp.min(
+            jnp.where(y > 0, pm2[None, :] - wS + eps, big), axis=1
+        )
+        pr2 = jnp.maximum(jnp.minimum(pr, bound_r), -big_d)
+        # col->sink residual (cap-z>0): psink <= pm + eps
+        bound_s = jnp.min(jnp.where(col_cap - z > 0, pm2 + eps, big))
+        psink2 = jnp.maximum(jnp.minimum(psink, bound_s), -big_d)
+        return pr2, pm2, psink2
+
+    return lax.fori_loop(0, waves, body, (pr, pm, psink))
+
+
 def transport_superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps):
     """One synchronous push/relabel wave over the dense bipartite
     residual graph. A fixed point once no node has positive excess, so
@@ -526,9 +578,9 @@ def transport_fori_tiered(wLo, wHi, R, supply, col_cap, num_supersteps: int,
         y2, pm2, s2, conv2 = run(eps_full)
         return y2, pm2, s1 + s2, conv2
 
-    # an eps0 already at the full range would retry the IDENTICAL solve
-    # (reachable since choose_eps0 picks eps_full on oversubscription)
-    return lax.cond(conv1 | (i32(eps0) >= eps_full), keep, retry, operand=None)
+    # plain `conv1` on purpose — see the note in transport_fori: the
+    # skip-identical-retry gate form crashes the tunneled TPU runtime
+    return lax.cond(conv1, keep, retry, operand=None)
 
 
 def solve_single_class(w, supply, col_cap):
@@ -582,7 +634,7 @@ def split_grants_by_class(y_tot, supply):
 
 
 def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
-                    pm_init=None):
+                    pm_init=None, refine_waves: int = 0):
     """The cost-scaling phase schedule as a bounded lax.while_loop:
     each iteration either runs a superstep (while active nodes exist)
     or advances the eps phase; exits as soon as the eps=1 phase drains
@@ -612,11 +664,30 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
         def next_phase(_):
             finished = eps <= 1
             new_eps = jnp.maximum(i32(1), eps // alpha)
-            y2, z2 = transport_saturate(wS, U, col_cap, y, z, pr, pm, psink)
+            if refine_waves:
+                # price refinement: tighten potentials for the CURRENT
+                # converged flow at the next eps, then reset only the
+                # arcs still violating it — instead of re-flooding
+                # every negative arc and re-fighting each contended
+                # column from scratch every phase.
+                pr2, pm2, psink2 = _price_refine(
+                    wS, U, col_cap, y, z, pr, pm, psink, new_eps,
+                    refine_waves,
+                )
+                y2, z2 = transport_saturate_eps(
+                    wS, U, col_cap, y, z, pr2, pm2, psink2, new_eps
+                )
+            else:
+                pr2, pm2, psink2 = pr, pm, psink
+                y2, z2 = transport_saturate(
+                    wS, U, col_cap, y, z, pr, pm, psink
+                )
             return (
                 jnp.where(finished, y, y2),
                 jnp.where(finished, z, z2),
-                pr, pm, psink,
+                jnp.where(finished, pr, pr2),
+                jnp.where(finished, pm, pm2),
+                jnp.where(finished, psink, psink2),
                 jnp.where(finished, eps, new_eps),
                 steps,
                 finished,
@@ -641,7 +712,8 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
 
 def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
                    eps0: Optional[int] = None, class_degenerate: bool = False,
-                   pm0=None):
+                   pm0=None, eps0_budget: Optional[int] = None,
+                   refine_waves: int = 0):
     """Bounded transport solve, embeddable in larger jitted programs.
 
     C == 1: the exact closed form (solve_single_class) — O(sort(M)).
@@ -693,11 +765,18 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
         return transport_solve(
             wS, supply, col_cap, eps_full, pm0,
             alpha=alpha, max_supersteps=num_supersteps,
+            refine_waves=refine_waves,
         )
 
+    # eps0_budget bounds ONLY the short first attempt: when the short
+    # schedule is instance-dependent (great on some shapes, a stall on
+    # others), a small budget caps the damage before the full-range
+    # retry — instead of burning the whole num_supersteps first.
     y1, pm1, s1, conv1 = transport_solve(
         wS, supply, col_cap, i32(eps0), pm0,
-        alpha=alpha, max_supersteps=num_supersteps,
+        alpha=alpha,
+        max_supersteps=min(eps0_budget or num_supersteps, num_supersteps),
+        refine_waves=refine_waves,
     )
 
     def keep(_):
@@ -708,17 +787,23 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
         y2, pm2, s2, conv2 = transport_solve(
             wS, supply, col_cap, eps_full, None,
             alpha=alpha, max_supersteps=num_supersteps,
+            refine_waves=refine_waves,
         )
         return y2, pm2, s1 + s2, conv2
 
-    # an eps0 already at the full range (and cold) would retry the
-    # IDENTICAL solve — reachable since choose_eps0 picks eps_full on
-    # oversubscription; skip unless a warm start pm0 differentiates it
-    same_retry = (i32(eps0) >= eps_full) if pm0 is None else jnp.bool_(False)
-    return lax.cond(conv1 | same_retry, keep, retry, operand=None)
+    # NOTE: the retry predicate must stay plain `conv1`. Gating it with
+    # `conv1 | (i32(eps0) >= eps_full)` (to skip an identical retry
+    # when choose_eps0 already picked the full range) deterministically
+    # crashed the TPU worker on the tunneled runtime whenever this ran
+    # inside a scanned round — a runtime miscompile we can only avoid.
+    # The duplicated full-range retry only fires on a non-converged
+    # oversubscribed solve, a rare path worth the waste.
+    return lax.cond(conv1, keep, retry, operand=None)
 
 
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps"))
+@functools.partial(
+    jax.jit, static_argnames=("alpha", "max_supersteps", "refine_waves")
+)
 def _solve_transport(
     wS,  # int32[C, Mp1] scaled costs (column Mp1-1 = unsched, 0)
     supply,  # int32[C]
@@ -727,10 +812,12 @@ def _solve_transport(
     pm0=None,  # optional int32[Mp1] carried machine prices
     alpha: int = 8,
     max_supersteps: int = 20_000,
+    refine_waves: int = 0,
 ):
     U = jnp.minimum(supply[:, None], col_cap[None, :])  # fwd arc capacity
     y, z, pm, steps, converged = _transport_loop(
-        wS, U, supply, col_cap, eps_init, alpha, max_supersteps, pm_init=pm0
+        wS, U, supply, col_cap, eps_init, alpha, max_supersteps, pm_init=pm0,
+        refine_waves=refine_waves,
     )
     return y, pm, steps, converged
 
