@@ -185,25 +185,48 @@ def _device_bench(
             f"R={R}: per-round latency unmeasurable over this transport"
         )
 
-    chunks = max(3, -(-rounds // R))  # >= 3 chunks for a meaningful p50
-    per_round_ms = []
-    chunk_walls_ms = []
-    chunk_stats = []
-    for rep in range(chunks):
-        wall_ms, stats = timed_chunk(R, seed=2 + rep)
-        if wall_ms < min_wall_ms:
-            # transport flakiness (documented: occasional impossibly
-            # fast readings) - retry the chunk once, then fail loudly
-            wall_ms, stats = timed_chunk(R, seed=100 + rep)
+    while True:
+        # a measured chunk can still undercut the bar (heavy round-to-
+        # round variance, or a sub-bar reading the probe's 4x margin
+        # missed): retry it once, then GROW R and restart measurement
+        # rather than reporting a number the bar does not cover
+        chunks = max(3, -(-rounds // R))  # >= 3 chunks for the p50
+        per_round_ms = []
+        chunk_walls_ms = []
+        chunk_stats = []
+        grown = False
+        for rep in range(chunks):
+            wall_ms, stats = timed_chunk(R, seed=2 + rep)
             if wall_ms < min_wall_ms:
-                raise RuntimeError(
-                    f"chunk {rep} wall {wall_ms:.2f} ms below the "
-                    f"{min_wall_ms:.0f} ms bar twice - rejecting the "
-                    "measurement"
-                )
-        chunk_walls_ms.append(round(wall_ms, 1))
-        per_round_ms.append(wall_ms / R)
-        chunk_stats.append(stats)
+                wall_ms, stats = timed_chunk(R, seed=100 + rep)
+            if wall_ms < min_wall_ms:
+                if R >= (1 << 20):
+                    raise RuntimeError(
+                        f"chunk {rep} wall {wall_ms:.2f} ms below the "
+                        f"{min_wall_ms:.0f} ms bar at R={R} - rejecting "
+                        "the measurement"
+                    )
+                if verbose:
+                    print(
+                        f"# chunk {rep} wall {wall_ms:.1f} ms under the "
+                        f"{min_wall_ms:.0f} ms bar - growing R from {R}",
+                        file=sys.stderr,
+                    )
+                R *= 4
+                # warm the new-R executable AND drain it with the same
+                # scalar-fetch barrier as timed chunks: block_until_ready
+                # alone can return early here, and an undrained warm-up
+                # chain would bleed into the restarted rep-0 wall
+                warm = dev.run_steady_rounds(R, churn, churn_n, seed=1)
+                jax.block_until_ready(warm)
+                np.asarray(jax.device_get(warm["live"][-1]))
+                grown = True
+                break
+            chunk_walls_ms.append(round(wall_ms, 1))
+            per_round_ms.append(wall_ms / R)
+            chunk_stats.append(stats)
+        if not grown:
+            break
 
     # Clock stopped — now fetch and verify everything.
     fill_got = dev.fetch_stats(fill)

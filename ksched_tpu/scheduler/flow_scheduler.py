@@ -104,6 +104,9 @@ class FlowScheduler:
         self.jobs_to_schedule: Dict[int, JobDescriptor] = {}
         self.runnable_tasks: Dict[int, Set[int]] = {}
         self.last_timing = RoundTiming()
+        #: pipelined-round state: (solver token, timing, round t0) while
+        #: a dispatched solve is in flight, else None
+        self._round_in_flight = None
 
     # ------------------------------------------------------------------
     # Event API
@@ -117,6 +120,7 @@ class FlowScheduler:
 
     def handle_job_completion(self, job_id: int) -> None:
         """Reference: flowscheduler/scheduler.go:93-104."""
+        self._check_not_in_flight("handle_job_completion")
         self.gm.job_completed(job_id)
         jd = self.job_map.find(job_id)
         assert jd is not None, f"job {job_id} must exist"
@@ -126,6 +130,7 @@ class FlowScheduler:
 
     def handle_task_completion(self, td: TaskDescriptor) -> None:
         """Reference: flowscheduler/scheduler.go:106-132."""
+        self._check_not_in_flight("handle_task_completion")
         rid = self.task_bindings.get(td.uid)
         assert rid is not None, f"task {td.uid} must be bound to a resource"
         if not self._unbind_task_from_resource(td, rid):
@@ -153,6 +158,7 @@ class FlowScheduler:
 
     def deregister_resource(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
         """Reference: flowscheduler/scheduler.go:162-210."""
+        self._check_not_in_flight("deregister_resource")
         self._dfs_evict_tasks(rtnd)
         self.gm.remove_resource_topology(rtnd.resource_desc)
         rid = resource_id_from_string(rtnd.resource_desc.uuid)
@@ -202,6 +208,7 @@ class FlowScheduler:
 
     def handle_task_failure(self, td: TaskDescriptor) -> None:
         """Reference: flowscheduler/scheduler.go:272-287."""
+        self._check_not_in_flight("handle_task_failure")
         self.gm.task_failed(td.uid)
         rid = self.task_bindings.get(td.uid)
         assert rid is not None, f"failed task {td.uid} should have been bound"
@@ -210,6 +217,7 @@ class FlowScheduler:
 
     def kill_running_task(self, task_id: int) -> None:
         """Reference: flowscheduler/scheduler.go:289-306."""
+        self._check_not_in_flight("kill_running_task")
         self.gm.task_killed(task_id)
         td = self.task_map.find(task_id)
         assert td is not None, f"unknown task {task_id}"
@@ -229,53 +237,69 @@ class FlowScheduler:
         ]
         return self.schedule_jobs(jds)
 
-    def schedule_jobs(self, jds: List[JobDescriptor]):
-        """Reference: flowscheduler/scheduler.go:321-338."""
+    # ------------------------------------------------------------------
+    # Pipelined rounds: dispatch the solve, overlap host work, finish
+    # ------------------------------------------------------------------
+
+    def schedule_all_jobs_async(self):
+        """Phase 1 of a pipelined round: stats refresh + graph update +
+        solve DISPATCH; returns before the solve completes. While the
+        round is in flight the caller may keep ADDING jobs and tasks —
+        their graph mutations journal for the next round, mirroring the
+        reference's pod batching (k8sclient/client.go:153-193) which
+        accumulates arrivals while the solver subprocess crunches.
+        Events that mutate existing placements (completion, failure,
+        kill, deregister) raise until finish_scheduling() applies the
+        in-flight round's deltas. Returns None when no job has runnable
+        tasks (nothing dispatched; finish_scheduling must not be
+        called)."""
+        if self._round_in_flight is not None:
+            raise RuntimeError("a scheduling round is already in flight")
+        jds = [
+            jd for jd in self.jobs_to_schedule.values()
+            if len(self._compute_runnable_tasks_for_job(jd)) > 0
+        ]
+        if not jds:
+            return None
         timing = RoundTiming()
         t_round = time.perf_counter()
-        num_scheduled = 0
-        deltas: List[SchedulingDelta] = []
-        if jds:
-            # Reset the mutation counters at round START (the reference
-            # resets after the round, flowscheduler/scheduler.go:332,
-            # which zeroes them before any post-round reader — e.g. the
-            # round tracer — can observe the round's mutation counts).
-            self.dimacs_stats.reset()
-            t0 = time.perf_counter()
-            self.gm.compute_topology_statistics(self.gm.sink_node)
-            timing.stats_s = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            self.gm.add_or_update_job_nodes(jds)
-            timing.graph_update_s = time.perf_counter() - t0
-            num_scheduled, deltas = self._run_scheduling_iteration(timing)
-            # Drop equivalence-class nodes nothing points at anymore so
-            # long-running deployments don't accumulate them. The
-            # reference declares this API but never calls it
-            # (graph_manager.go:347-357); upstream Firmament purges in
-            # its scheduling loop, which is the behavior kept here
-            # (debounced — see the graph manager's docstring).
-            self.gm.purge_unconnected_equiv_class_nodes()
-            # Policy feedback: which runnable tasks stayed unscheduled
-            # (drives e.g. Quincy's wait-cost starvation bound).
-            unscheduled = [
-                t
-                for tasks in self.runnable_tasks.values()
-                for t in tasks
-                if t not in self.task_bindings
-            ]
-            self.cost_model.note_round(unscheduled)
-        timing.total_s = time.perf_counter() - t_round
-        self.last_timing = timing
-        return num_scheduled, deltas
-
-    def _run_scheduling_iteration(self, timing: RoundTiming):
-        """Reference: flowscheduler/scheduler.go:340-375."""
+        self.dimacs_stats.reset()
         t0 = time.perf_counter()
-        task_mappings = self.solver.solve()
-        timing.solve_s = time.perf_counter() - t0
-
+        self.gm.compute_topology_statistics(self.gm.sink_node)
+        timing.stats_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        deltas = self.gm.scheduling_deltas_for_preempted_tasks(task_mappings, self.resource_map)
+        self.gm.add_or_update_job_nodes(jds)
+        timing.graph_update_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        token = self.solver.solve_async()
+        timing.solve_s = time.perf_counter() - t0  # dispatch only
+        self._round_in_flight = (token, timing, t_round)
+        return token
+
+    def finish_scheduling(self):
+        """Phase 2: synchronize the solve, apply deltas, close the
+        round. Returns (num_scheduled, deltas) like schedule_jobs."""
+        if self._round_in_flight is None:
+            raise RuntimeError("no scheduling round in flight")
+        token, timing, t_round = self._round_in_flight
+        t0 = time.perf_counter()
+        task_mappings = self.solver.complete(token)
+        timing.solve_s += time.perf_counter() - t0  # + synchronize
+        # delta application mutates placements; the in-flight guard
+        # must be off for the internal placement/eviction handlers
+        self._round_in_flight = None
+        return self._finish_round(task_mappings, timing, t_round)
+
+    def _finish_round(self, task_mappings, timing, t_round):
+        """The post-solve half of a round, shared by the synchronous
+        and pipelined paths (so delta decoding / feedback can never
+        drift between them): preemption deltas + binding diffs, delta
+        application, per-root topology refresh, EC purge, and the
+        unscheduled-feedback hook."""
+        t0 = time.perf_counter()
+        deltas = self.gm.scheduling_deltas_for_preempted_tasks(
+            task_mappings, self.resource_map
+        )
         for task_node_id, res_node_id in task_mappings.items():
             delta = self.gm.node_binding_to_scheduling_delta(
                 task_node_id, res_node_id, self.task_bindings
@@ -289,7 +313,53 @@ class FlowScheduler:
         for rid in self.resource_roots:
             self.gm.update_resource_topology(self._root_rtnds[rid])
         timing.apply_s = time.perf_counter() - t0
+        self.gm.purge_unconnected_equiv_class_nodes()
+        # Policy feedback: which runnable tasks stayed unscheduled
+        # (drives e.g. Quincy's wait-cost starvation bound).
+        unscheduled = [
+            t
+            for tasks in self.runnable_tasks.values()
+            for t in tasks
+            if t not in self.task_bindings
+        ]
+        self.cost_model.note_round(unscheduled)
+        timing.total_s = time.perf_counter() - t_round
+        self.last_timing = timing
         return num_scheduled, deltas
+
+    def _check_not_in_flight(self, what: str) -> None:
+        if self._round_in_flight is not None:
+            raise RuntimeError(
+                f"{what} while a pipelined scheduling round is in flight; "
+                "call finish_scheduling() first (only job/task ADDITIONS "
+                "may overlap an in-flight round)"
+            )
+
+    def schedule_jobs(self, jds: List[JobDescriptor]):
+        """Reference: flowscheduler/scheduler.go:321-338."""
+        self._check_not_in_flight("schedule_jobs")
+        timing = RoundTiming()
+        t_round = time.perf_counter()
+        if not jds:
+            timing.total_s = time.perf_counter() - t_round
+            self.last_timing = timing
+            return 0, []
+        # Reset the mutation counters at round START (the reference
+        # resets after the round, flowscheduler/scheduler.go:332,
+        # which zeroes them before any post-round reader — e.g. the
+        # round tracer — can observe the round's mutation counts).
+        self.dimacs_stats.reset()
+        t0 = time.perf_counter()
+        self.gm.compute_topology_statistics(self.gm.sink_node)
+        timing.stats_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        self.gm.add_or_update_job_nodes(jds)
+        timing.graph_update_s = time.perf_counter() - t0
+        # Reference round body: flowscheduler/scheduler.go:340-375.
+        t0 = time.perf_counter()
+        task_mappings = self.solver.solve()
+        timing.solve_s = time.perf_counter() - t0
+        return self._finish_round(task_mappings, timing, t_round)
 
     def _apply_scheduling_deltas(self, deltas: List[SchedulingDelta]) -> int:
         """Reference: flowscheduler/scheduler.go:377-412."""

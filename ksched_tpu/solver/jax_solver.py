@@ -306,13 +306,20 @@ class JaxSolver(FlowSolver):
             # endpoints match, checked in solve().
         return self._plan_dev
 
-    def solve(self, problem: FlowProblem) -> FlowResult:
+    def solve_async(self, problem: FlowProblem):
+        """Dispatch the warm attempt WITHOUT synchronizing and return an
+        opaque pending token for complete(). The device works while the
+        host is free to build the next round's graph — the pipelining
+        seam the reference's daemon-mode solver implies
+        (placement/solver.go:60-90): its subprocess crunches DIMACS
+        concurrently with the Go process, and here the asynchronous
+        dispatch gives the same overlap in-process."""
         n = problem.num_nodes
         m = len(problem.src)
         if m == 0 or problem.num_arcs == 0:
             if (problem.excess > 0).any():
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
-            return FlowResult(flow=np.zeros(m, dtype=np.int64), objective=0, iterations=0)
+            return (problem, None, None, None)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
         cap = problem.cap.astype(np.int32)
@@ -345,25 +352,41 @@ class JaxSolver(FlowSolver):
         # (cheap, exact, and in practice a handful of supersteps per
         # delta). Attempt 2: genuinely cold — zero flow and full
         # cost-scaling — so a poisoned warm state can always recover.
-        attempts = [
-            (flow0, 1, min(4096, self.max_supersteps)),
-            (np.zeros(m, dtype=np.int32), max(1, max_cost * n), self.max_supersteps),
-        ]
-        flow = p = steps = None
-        converged = p_overflow = False
-        for f0, eps_init, cap_steps in attempts:
+        # Only attempt 1 is dispatched here; the cold fallback runs
+        # synchronously in complete() if needed (rare).
+        dev_args = (
+            jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply),
+        )
+        fut = _solve_mcmf(
+            *dev_args,
+            jnp.asarray(flow0),
+            jnp.asarray(np.int32(1)),
+            *plan_dev,
+            alpha=self.alpha,
+            max_supersteps=min(4096, self.max_supersteps),
+        )
+        cold = (np.zeros(m, dtype=np.int32), max(1, max_cost * n))
+        return (problem, fut, (dev_args, plan_dev, cold), None)
+
+    def complete(self, pending) -> FlowResult:
+        """Synchronize a solve_async dispatch into a FlowResult."""
+        problem, fut, rest, _ = pending
+        if fut is None:
+            return FlowResult(
+                flow=np.zeros(len(problem.src), dtype=np.int64),
+                objective=0, iterations=0,
+            )
+        flow, p, steps, converged, p_overflow = fut
+        if not (bool(converged) and not bool(p_overflow)):
+            dev_args, plan_dev, (f0_cold, eps_cold) = rest
             flow, p, steps, converged, p_overflow = _solve_mcmf(
-                jnp.asarray(cap),
-                jnp.asarray(cost),
-                jnp.asarray(supply),
-                jnp.asarray(f0),
-                jnp.asarray(np.int32(eps_init)),
+                *dev_args,
+                jnp.asarray(f0_cold),
+                jnp.asarray(np.int32(eps_cold)),
                 *plan_dev,
                 alpha=self.alpha,
-                max_supersteps=cap_steps,
+                max_supersteps=self.max_supersteps,
             )
-            if bool(converged) and not bool(p_overflow):
-                break
         self.last_supersteps = int(steps)
         if bool(p_overflow) or not bool(converged):
             self._prev = None  # never reuse the state that failed
@@ -381,3 +404,6 @@ class JaxSolver(FlowSolver):
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
         ) + lower_bound_cost(problem)
         return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))
+
+    def solve(self, problem: FlowProblem) -> FlowResult:
+        return self.complete(self.solve_async(problem))

@@ -27,7 +27,15 @@ class PlacementSolver:
         self._started = False
         self.last_result = None
 
-    def solve(self) -> TaskMapping:
+    def solve_async(self):
+        """Phase 1 of a pipelined round: export the journal, snapshot
+        the problem, and DISPATCH the backend solve, returning before
+        it completes. The problem arrays are a snapshot, so the caller
+        may keep journaling next-round graph mutations while the solve
+        is in flight — the overlap the reference's daemon-mode
+        subprocess provides across its pipe boundary
+        (placement/solver.go:60-90). Backends without solve_async run
+        synchronously here (the token then carries the result)."""
         gm = self.gm
         if not self._started or not self.incremental:
             self._started = True
@@ -43,9 +51,20 @@ class PlacementSolver:
         self.state.set_excess(gm.sink_node.id, gm.sink_node.excess)
 
         problem = self.state.problem()
-        result = self.backend.solve(problem)
-        self.last_result = result
+        # Task nodes captured NOW: the decode must map the snapshot's
+        # tasks, not tasks added while the solve is in flight.
         task_node_ids = [node.id for node in gm.task_to_node.values()]
+        if hasattr(self.backend, "solve_async"):
+            pending = self.backend.solve_async(problem)
+            return (problem, task_node_ids, pending, True)
+        return (problem, task_node_ids, self.backend.solve(problem), False)
+
+    def complete(self, token) -> TaskMapping:
+        """Phase 2: synchronize the solve and decode the task mapping."""
+        problem, task_node_ids, pending, is_async = token
+        result = self.backend.complete(pending) if is_async else pending
+        self.last_result = result
+        gm = self.gm
         return flow_to_mapping(
             problem,
             result.total_flow(problem),
@@ -53,3 +72,6 @@ class PlacementSolver:
             gm.sink_node.id,
             task_node_ids,
         )
+
+    def solve(self) -> TaskMapping:
+        return self.complete(self.solve_async())
