@@ -14,9 +14,50 @@ GET /_test/bindings.
 from __future__ import annotations
 
 import json
+import ssl
+import subprocess
+import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 from typing import Dict, List, Optional
+
+
+#: process-wide cert cache: one keygen (+ one auto-cleaned temp dir)
+#: shared by every TLS-mode server in the process
+_CERT_DIR: Optional[tempfile.TemporaryDirectory] = None
+_CERT_PATHS: Optional[tuple] = None
+
+
+def make_self_signed_cert(directory: Optional[str] = None):
+    """(cert_path, key_path) for a 127.0.0.1 self-signed cert, via the
+    system openssl CLI (hermetic TLS tests; no cryptography dep).
+    Without `directory`, the pair is generated once per process into a
+    TemporaryDirectory cleaned up at interpreter exit — RSA keygen
+    costs ~100 ms and every FakeAPIServer(tls=True) would otherwise
+    leak a fresh /tmp dir."""
+    global _CERT_DIR, _CERT_PATHS
+    if directory is None and _CERT_PATHS is not None:
+        return _CERT_PATHS
+    if directory is None:
+        _CERT_DIR = tempfile.TemporaryDirectory(prefix="ksched_tls_")
+        d = Path(_CERT_DIR.name)
+    else:
+        d = Path(directory)
+    cert, key = d / "cert.pem", d / "key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", str(key), "-out", str(cert),
+            "-days", "1", "-nodes", "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        check=True, capture_output=True,
+    )
+    if directory is None:
+        _CERT_PATHS = (str(cert), str(key))
+        return _CERT_PATHS
+    return str(cert), str(key)
 
 
 class _State:
@@ -47,6 +88,7 @@ class _State:
 
 class _Handler(BaseHTTPRequestHandler):
     state: _State  # set by FakeAPIServer
+    bearer: Optional[str] = None  # require this token when set
 
     def log_message(self, *args) -> None:  # silence request logging
         pass
@@ -63,7 +105,17 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(n).decode()) if n else {}
 
+    def _authorized(self) -> bool:
+        if self.bearer is None:
+            return True
+        if self.headers.get("Authorization") == f"Bearer {self.bearer}":
+            return True
+        self._json(401, {"error": "unauthorized"})
+        return False
+
     def do_GET(self) -> None:
+        if not self._authorized():
+            return
         st = self.state
         if self.path.startswith("/api/v1/pods"):
             with st.lock:
@@ -85,6 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": f"no route {self.path}"})
 
     def do_POST(self) -> None:
+        if not self._authorized():
+            return
         st = self.state
         parts = self.path.strip("/").split("/")
         # /api/v1/namespaces/{ns}/pods/{name}/binding
@@ -132,18 +186,55 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class FakeAPIServer:
-    """Threaded loopback server; `base_url` after start()."""
+    """Threaded loopback server; `base_url` after start().
 
-    def __init__(self) -> None:
+    `tls=True` serves https with a freshly generated self-signed
+    127.0.0.1 cert (`ca_cert_path` is what clients should pin);
+    `bearer` requires `Authorization: Bearer <token>` on every route
+    (401 otherwise) — the hermetic stand-in for a kube-apiserver with
+    token auth (the reference's client is built with credentials,
+    k8s/k8sclient/client.go:34-42)."""
+
+    def __init__(self, tls: bool = False, bearer: Optional[str] = None) -> None:
         self._state = _State()
-        handler = type("Handler", (_Handler,), {"state": self._state})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        handler = type(
+            "Handler", (_Handler,), {"state": self._state, "bearer": bearer}
+        )
+        self._tls = bool(tls)
+        self.ca_cert_path: Optional[str] = None
+        if tls:
+            cert, key = make_self_signed_cert()
+            self.ca_cert_path = cert
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert, key)
+
+            class _TLSServer(ThreadingHTTPServer):
+                # Per-CONNECTION wrap with a handshake timeout. Wrapping
+                # the listening socket instead would run handshakes with
+                # no timeout inside serve_forever, where one client that
+                # fails (or stalls) the handshake raises out of / blocks
+                # the serve loop — after which shutdown() waits forever.
+                # SSL failures raised here are OSErrors, which
+                # socketserver's accept path swallows per-connection.
+                def get_request(self_inner):
+                    sock, addr = self_inner.socket.accept()
+                    sock.settimeout(5)
+                    try:
+                        return ctx.wrap_socket(sock, server_side=True), addr
+                    except (ssl.SSLError, OSError):
+                        sock.close()
+                        raise
+
+            self._httpd = _TLSServer(("127.0.0.1", 0), handler)
+        else:
+            self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self._thread: Optional[threading.Thread] = None
 
     @property
     def base_url(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "FakeAPIServer":
         self._thread = threading.Thread(

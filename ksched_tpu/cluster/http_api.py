@@ -15,13 +15,18 @@ pattern over the rebuild's ClusterAPI protocol:
   {"target": {"kind": "Node", "name": node}} body (client.go:128-147).
 
 stdlib urllib only — no client dependencies. Pairs with
-cluster/fake_apiserver.py for hermetic tests and demos; pointing it at
-a real kube-apiserver needs only auth plumbing.
+cluster/fake_apiserver.py for hermetic tests and demos. Auth plumbing
+for a real kube-apiserver (the reference builds an authenticated
+client, k8s/k8sclient/client.go:34-42): `bearer_token` rides every
+request as an Authorization header, `ca_cert` pins the server cert for
+https URLs, and `client_cert`/`client_key` enable mTLS — exercised
+hermetically against the fake server's TLS mode.
 """
 
 from __future__ import annotations
 
 import json
+import ssl
 import threading
 import urllib.error
 import urllib.request
@@ -38,10 +43,29 @@ class HTTPClusterAPI(ClusterAPI):
         namespace: str = "default",
         poll_interval_s: float = 0.2,
         pod_chan_size: int = 5000,
+        bearer_token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        client_cert: Optional[str] = None,
+        client_key: Optional[str] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.namespace = namespace
         self.poll_interval_s = poll_interval_s
+        self._auth_headers = (
+            {"Authorization": f"Bearer {bearer_token}"} if bearer_token else {}
+        )
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https"):
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
+            if client_cert:
+                self._ssl_ctx.load_cert_chain(client_cert, client_key)
+        elif ca_cert or client_cert or client_key:
+            # cert material with a plain-http URL is always a config
+            # mistake (a forgotten scheme would silently drop the mTLS
+            # identity and send the bearer token in cleartext)
+            raise ValueError(
+                "ca_cert/client_cert/client_key require an https base_url"
+            )
         # The channel+debounce layer is shared with the synthetic
         # control plane; this adapter only adds the HTTP watch/post.
         self._chan = SyntheticClusterAPI(pod_chan_size=pod_chan_size)
@@ -59,9 +83,17 @@ class HTTPClusterAPI(ClusterAPI):
 
     # -- HTTP plumbing -----------------------------------------------------
 
+    def _open(self, req_or_url, timeout: float = 5):
+        return urllib.request.urlopen(
+            req_or_url, timeout=timeout, context=self._ssl_ctx
+        )
+
     def _get_json(self, path: str) -> Optional[dict]:
         try:
-            with urllib.request.urlopen(self.base_url + path, timeout=5) as r:
+            req = urllib.request.Request(
+                self.base_url + path, headers=dict(self._auth_headers)
+            )
+            with self._open(req) as r:
                 return json.loads(r.read().decode())
         except (urllib.error.URLError, OSError, json.JSONDecodeError):
             return None  # transient outage: informers keep retrying
@@ -144,10 +176,10 @@ class HTTPClusterAPI(ClusterAPI):
         req = urllib.request.Request(
             f"{self.base_url}/api/v1/namespaces/{self.namespace}/pods",
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **self._auth_headers},
             method="POST",
         )
-        urllib.request.urlopen(req, timeout=5).read()
+        self._open(req).read()
 
     def bindings(self) -> dict:
         """Pod→node placements this adapter successfully posted."""
@@ -168,11 +200,11 @@ class HTTPClusterAPI(ClusterAPI):
                 f"{self.base_url}/api/v1/namespaces/{self.namespace}"
                 f"/pods/{b.pod_id}/binding",
                 data=body,
-                headers={"Content-Type": "application/json"},
+                headers={"Content-Type": "application/json", **self._auth_headers},
                 method="POST",
             )
             try:
-                urllib.request.urlopen(req, timeout=5).read()
+                self._open(req).read()
             except (urllib.error.URLError, OSError):
                 # The reference logs and moves on (client.go:141-146);
                 # the pod stays pending and re-enters a later batch.

@@ -261,15 +261,7 @@ class FlowScheduler:
         ]
         if not jds:
             return None
-        timing = RoundTiming()
-        t_round = time.perf_counter()
-        self.dimacs_stats.reset()
-        t0 = time.perf_counter()
-        self.gm.compute_topology_statistics(self.gm.sink_node)
-        timing.stats_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        self.gm.add_or_update_job_nodes(jds)
-        timing.graph_update_s = time.perf_counter() - t0
+        timing, t_round = self._begin_round(jds)
         t0 = time.perf_counter()
         token = self.solver.solve_async()
         timing.solve_s = time.perf_counter() - t0  # dispatch only
@@ -283,12 +275,36 @@ class FlowScheduler:
             raise RuntimeError("no scheduling round in flight")
         token, timing, t_round = self._round_in_flight
         t0 = time.perf_counter()
-        task_mappings = self.solver.complete(token)
+        try:
+            task_mappings = self.solver.complete(token)
+        finally:
+            # the latch must clear even when the solver raises
+            # (overflow / non-convergence), or every later event
+            # handler would refuse with "in flight" forever — and it
+            # must be off before delta application anyway, for the
+            # internal placement/eviction handlers
+            self._round_in_flight = None
         timing.solve_s += time.perf_counter() - t0  # + synchronize
-        # delta application mutates placements; the in-flight guard
-        # must be off for the internal placement/eviction handlers
-        self._round_in_flight = None
         return self._finish_round(task_mappings, timing, t_round)
+
+    def _begin_round(self, jds):
+        """The pre-solve half of a round, shared by the synchronous
+        and pipelined paths: mutation-counter reset, topology stats
+        refresh, and the job/task graph update."""
+        timing = RoundTiming()
+        t_round = time.perf_counter()
+        # Reset the mutation counters at round START (the reference
+        # resets after the round, flowscheduler/scheduler.go:332,
+        # which zeroes them before any post-round reader — e.g. the
+        # round tracer — can observe the round's mutation counts).
+        self.dimacs_stats.reset()
+        t0 = time.perf_counter()
+        self.gm.compute_topology_statistics(self.gm.sink_node)
+        timing.stats_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        self.gm.add_or_update_job_nodes(jds)
+        timing.graph_update_s = time.perf_counter() - t0
+        return timing, t_round
 
     def _finish_round(self, task_mappings, timing, t_round):
         """The post-solve half of a round, shared by the synchronous
@@ -338,23 +354,11 @@ class FlowScheduler:
     def schedule_jobs(self, jds: List[JobDescriptor]):
         """Reference: flowscheduler/scheduler.go:321-338."""
         self._check_not_in_flight("schedule_jobs")
-        timing = RoundTiming()
-        t_round = time.perf_counter()
         if not jds:
-            timing.total_s = time.perf_counter() - t_round
+            timing = RoundTiming()
             self.last_timing = timing
             return 0, []
-        # Reset the mutation counters at round START (the reference
-        # resets after the round, flowscheduler/scheduler.go:332,
-        # which zeroes them before any post-round reader — e.g. the
-        # round tracer — can observe the round's mutation counts).
-        self.dimacs_stats.reset()
-        t0 = time.perf_counter()
-        self.gm.compute_topology_statistics(self.gm.sink_node)
-        timing.stats_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        self.gm.add_or_update_job_nodes(jds)
-        timing.graph_update_s = time.perf_counter() - t0
+        timing, t_round = self._begin_round(jds)
         # Reference round body: flowscheduler/scheduler.go:340-375.
         t0 = time.perf_counter()
         task_mappings = self.solver.solve()

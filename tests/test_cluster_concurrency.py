@@ -1,0 +1,209 @@
+"""Concurrency stress for the threaded cluster adapters + auth plumbing.
+
+The reference's only concurrency check is `go test --race`
+(hack/test.sh:17). Python has no TSan; the analogue here is adversarial
+stress: hammer the adapters' shared state from many threads and assert
+the conservation invariants that a race would break (events neither
+lost nor duplicated, bindings consistent between client and server,
+seen-sets bounded). Auth: the TLS + bearer modes of the fake API server
+(k8s/k8sclient/client.go:34-42 builds an authenticated client) are
+exercised hermetically over loopback with a self-signed cert.
+"""
+
+import threading
+import time
+
+
+from ksched_tpu.cluster import Binding, FakeAPIServer, HTTPClusterAPI
+from ksched_tpu.cluster.synthetic_api import SyntheticClusterAPI
+from ksched_tpu.cluster.api import PodEvent
+
+
+def _drain_and_bind(api, server, want, nodes, deadline_s=20.0):
+    """Consume pod batches and bind round-robin until `want` pods are
+    bound server-side (or the deadline passes)."""
+    bound = 0
+    t_end = time.monotonic() + deadline_s
+    i = 0
+    while bound < want and time.monotonic() < t_end:
+        batch = api.get_pod_batch(timeout_s=0.3)
+        if batch:
+            api.assign_bindings(
+                [Binding(p.pod_id, nodes[(i + k) % len(nodes)])
+                 for k, p in enumerate(batch)]
+            )
+            i += len(batch)
+        bound = len(server.bindings())
+    return bound
+
+
+# ---------------------------------------------------------------------------
+# auth: TLS + bearer token
+# ---------------------------------------------------------------------------
+
+
+def test_tls_bearer_end_to_end():
+    server = FakeAPIServer(tls=True, bearer="s3cret-token").start()
+    try:
+        for i in range(2):
+            server.add_node(f"node_{i}", cores=1, pus_per_core=2)
+        server.create_pods(4)
+        api = HTTPClusterAPI(
+            server.base_url,
+            poll_interval_s=0.05,
+            bearer_token="s3cret-token",
+            ca_cert=server.ca_cert_path,
+        )
+        try:
+            assert server.base_url.startswith("https://")
+            nodes = [n.node_id for n in api.get_node_batch(timeout_s=2.0)]
+            assert sorted(nodes) == ["node_0", "node_1"]
+            bound = _drain_and_bind(api, server, want=4, nodes=nodes)
+            assert bound == 4
+            assert api.bindings() == server.bindings()
+        finally:
+            api.close()
+    finally:
+        server.stop()
+
+
+def test_wrong_bearer_token_rejected():
+    server = FakeAPIServer(tls=True, bearer="right").start()
+    try:
+        server.add_node("node_0")
+        server.create_pods(2)
+        api = HTTPClusterAPI(
+            server.base_url,
+            poll_interval_s=0.05,
+            bearer_token="wrong",
+            ca_cert=server.ca_cert_path,
+        )
+        try:
+            # 401s: the watches surface nothing (get_pod_batch BLOCKS
+            # for the first pod by design — reference debounce
+            # semantics — so peek at the channel instead of draining),
+            # and binding POSTs fail without recording anything
+            time.sleep(1.0)
+            assert api._chan._pods.empty()
+            assert api._chan._nodes.empty()
+            api.assign_bindings([Binding("pod_0", "node_0")])
+            assert server.bindings() == {}
+            assert api.bindings() == {}
+        finally:
+            api.close()
+    finally:
+        server.stop()
+
+
+def test_tls_rejects_unpinned_client():
+    server = FakeAPIServer(tls=True).start()
+    try:
+        server.add_node("node_0")
+        # no ca_cert: the self-signed server cert fails verification,
+        # the informers keep retrying, nothing surfaces (channel peek —
+        # the batch getters block for the first event by design)
+        api = HTTPClusterAPI(server.base_url, poll_interval_s=0.05)
+        try:
+            time.sleep(1.0)
+            assert api._chan._pods.empty()
+            assert api._chan._nodes.empty()
+        finally:
+            api.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# race stress
+# ---------------------------------------------------------------------------
+
+
+def test_http_adapter_stress_concurrent_producers_and_binder():
+    """3 producer threads POST pods through the adapter while the main
+    thread drains batches and posts bindings; watch threads reconcile
+    concurrently. Invariants: every pod bound exactly once, client and
+    server agree, and the seen-set stays bounded by the pending
+    listing."""
+    server = FakeAPIServer().start()
+    n_nodes, per_producer, producers = 4, 25, 3
+    total = per_producer * producers
+    try:
+        for i in range(n_nodes):
+            server.add_node(f"node_{i}", cores=2, pus_per_core=2)
+        api = HTTPClusterAPI(server.base_url, poll_interval_s=0.02)
+        try:
+            nodes = [n.node_id for n in api.get_node_batch(timeout_s=2.0)]
+            assert len(nodes) == n_nodes
+
+            def produce(k):
+                for i in range(per_producer):
+                    api.create_pod(f"pod_{k}_{i}", task_class=i % 4)
+
+            threads = [
+                threading.Thread(target=produce, args=(k,))
+                for k in range(producers)
+            ]
+            for t in threads:
+                t.start()
+            bound = _drain_and_bind(api, server, want=total, nodes=nodes)
+            for t in threads:
+                t.join(timeout=5)
+            assert bound == total
+            server_bindings = server.bindings()
+            assert len(server_bindings) == total  # each pod exactly once
+            assert api.bindings() == server_bindings
+            # reconcile: with nothing pending, the seen-set drains
+            t_end = time.monotonic() + 5
+            while time.monotonic() < t_end:
+                with api._bindings_lock:
+                    if not api._seen_pods:
+                        break
+                time.sleep(0.05)
+            with api._bindings_lock:
+                assert not api._seen_pods
+        finally:
+            api.close()
+    finally:
+        server.stop()
+
+
+def test_synthetic_channel_conserves_events_under_contention():
+    """Many offerers vs one drainer vs close: accepted offers must all
+    be drained exactly once (no loss, no duplication), rejected offers
+    must not surface, and close() must not deadlock anyone."""
+    api = SyntheticClusterAPI(pod_chan_size=64)  # << total: backpressure
+    per_producer, producers = 300, 4
+    total = per_producer * producers
+    accepted = []
+    acc_lock = threading.Lock()
+
+    def offerer(k):
+        for i in range(per_producer):
+            ev = PodEvent(pod_id=f"p{k}_{i}")
+            # bounded-wait offers retried to acceptance: exactly
+            # per_producer accepted events per producer, with plenty of
+            # queue-full rejections along the way
+            while not api.offer_pod(ev, timeout_s=0.02):
+                pass
+            with acc_lock:
+                accepted.append(ev.pod_id)
+
+    threads = [
+        threading.Thread(target=offerer, args=(k,), daemon=True)
+        for k in range(producers)
+    ]
+    for t in threads:
+        t.start()
+    drained = []
+    # the total is known, so the drain can stop BEFORE a blocking call
+    # (get_pod_batch waits indefinitely for a first event by design —
+    # the reference's pod-channel contract)
+    while len(drained) < total:
+        drained.extend(p.pod_id for p in api.get_pod_batch(timeout_s=0.05))
+    for t in threads:
+        t.join(timeout=5)
+    api.close()
+    with acc_lock:
+        want = list(accepted)
+    assert sorted(drained) == sorted(want)
+    assert len(set(drained)) == len(drained)  # no duplication
