@@ -85,6 +85,8 @@ def _device_bench(
     decode_width=None,
     num_groups: int = 0,
     group_setup=None,  # (cluster, rng) -> per-task group ids for the fill
+    refine_waves: int = 8,  # matches the DeviceBulkCluster default
+    alpha: int = 8,
     label: str = "trivial cost model",
     verbose: bool = False,
 ) -> dict:
@@ -123,6 +125,8 @@ def _device_bench(
         ec_cost=ec_cost,
         decode_width=decode_width,
         num_groups=num_groups,
+        refine_waves=refine_waves,
+        alpha=alpha,
     )
     devices = jax.devices()
     churn_n = max(1, int(tasks * churn))
