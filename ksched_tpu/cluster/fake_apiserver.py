@@ -209,21 +209,30 @@ class FakeAPIServer:
             ctx.load_cert_chain(cert, key)
 
             class _TLSServer(ThreadingHTTPServer):
-                # Per-CONNECTION wrap with a handshake timeout. Wrapping
-                # the listening socket instead would run handshakes with
-                # no timeout inside serve_forever, where one client that
-                # fails (or stalls) the handshake raises out of / blocks
-                # the serve loop — after which shutdown() waits forever.
-                # SSL failures raised here are OSErrors, which
-                # socketserver's accept path swallows per-connection.
-                def get_request(self_inner):
-                    sock, addr = self_inner.socket.accept()
-                    sock.settimeout(5)
+                # Per-CONNECTION wrap with a handshake timeout, run on
+                # the per-connection handler thread (finish_request),
+                # NOT the accept thread: a client that stalls its
+                # handshake must cost only its own connection, not
+                # serialize every other accept behind its 5 s timeout.
+                # (Wrapping the listening socket would be worse still:
+                # handshakes with no timeout inside serve_forever, and
+                # a failed handshake raising out of the serve loop.)
+                # wrap_socket detaches the raw socket's fd into the SSL
+                # socket, so the caller's shutdown_request on the raw
+                # socket is a no-op; the wrapper is closed here.
+                def finish_request(self_inner, request, client_address):
+                    request.settimeout(5)
                     try:
-                        return ctx.wrap_socket(sock, server_side=True), addr
+                        tls_sock = ctx.wrap_socket(request, server_side=True)
                     except (ssl.SSLError, OSError):
-                        sock.close()
-                        raise
+                        request.close()
+                        return
+                    try:
+                        self_inner.RequestHandlerClass(
+                            tls_sock, client_address, self_inner
+                        )
+                    finally:
+                        tls_sock.close()
 
             self._httpd = _TLSServer(("127.0.0.1", 0), handler)
         else:

@@ -99,7 +99,7 @@ class QuincyGroupTable:
             (c, 0, ()): c for c in range(self.C)
         }
         self._next = 2 * self.C
-        self.overflowed = 0  # signatures dropped to the overflow group
+        self.overflowed = 0  # DISTINCT signatures dropped to the overflow group
 
     # -- registration ------------------------------------------------------
 
@@ -135,9 +135,13 @@ class QuincyGroupTable:
             return int(task_class)  # the fallback group IS this signature
         if self._next >= self.G:
             # table full: land in the class's overflow group, repriced
-            # upward to cover the costliest overflowed signature
+            # upward to cover the costliest overflowed signature. The
+            # signature is memoized to the overflow gid so repeated
+            # registrations (task multiplicity) don't inflate the
+            # distinct-signatures-dropped counter.
             self.overflowed += 1
             gid = self.C + int(task_class)
+            self._sig2gid[sig] = gid
             self.e[gid] = max(self.e[gid], worst)
             self.u[gid] = self.e[gid] + 1
             return gid

@@ -6,7 +6,14 @@ ResourceStatus/TaskDescriptor, ResourceState LOST, ad hoc round timing)
 but implements none of them (SURVEY §5). Here they are first-class.
 """
 
-from .checkpoint import load_bulk_checkpoint, restore_scheduler, save_bulk_checkpoint, save_scheduler
+from .checkpoint import (
+    load_bulk_checkpoint,
+    load_device_checkpoint,
+    restore_scheduler,
+    save_bulk_checkpoint,
+    save_device_checkpoint,
+    save_scheduler,
+)
 from .failure import HeartbeatMonitor
 from .trace import RoundTracer
 
@@ -14,7 +21,9 @@ __all__ = [
     "HeartbeatMonitor",
     "RoundTracer",
     "load_bulk_checkpoint",
+    "load_device_checkpoint",
     "restore_scheduler",
     "save_bulk_checkpoint",
+    "save_device_checkpoint",
     "save_scheduler",
 ]

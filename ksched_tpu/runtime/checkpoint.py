@@ -190,3 +190,111 @@ def load_bulk_checkpoint(
         if not cluster.task_live[r]:
             cluster._job_free[r % cluster.J].append(r)
     return cluster
+
+
+# ---------------------------------------------------------------------------
+# DeviceBulkCluster (device-path) checkpoints
+# ---------------------------------------------------------------------------
+
+#: DeviceClusterState fields, in NamedTuple order
+_DEVICE_STATE = (
+    "live", "cls", "job", "pu", "pu_running", "machine_enabled", "grp",
+)
+#: GroupSpec fields (group mode only), prefixed g_ in the npz
+_DEVICE_GROUPS = ("cls", "job", "e", "u", "pref_w")
+
+
+def save_device_checkpoint(cluster, path: str) -> None:
+    """Snapshot a DeviceBulkCluster: geometry + solver knobs + the full
+    DeviceClusterState (placements, occupancy, membership, groups) and,
+    in group mode, the GroupSpec arrays. One bulk device->host fetch —
+    do this outside any timed region (docs/NOTES.md: the first fetch
+    permanently degrades later dispatch latency on tunneled TPUs)."""
+    meta = {
+        "version": CHECKPOINT_VERSION,
+        "num_machines": cluster.M,
+        "pus_per_machine": cluster.P,
+        "slots_per_pu": cluster.S,
+        "num_jobs": cluster.J,
+        "num_task_classes": cluster.C,
+        "task_capacity": cluster.Tcap,
+        "unsched_cost": cluster.unsched_cost,
+        "ec_cost": cluster.ec_cost,
+        "supersteps": cluster.supersteps,
+        "decode_width": -1 if cluster.decode_width is None else cluster.decode_width,
+        "alpha": cluster.alpha,
+        "preemption": int(cluster.preemption),
+        "continuation_discount": cluster.continuation_discount,
+        "num_groups": cluster.G if cluster.grouped else 0,
+        "active_groups_cap": cluster.active_groups_cap,
+        "refine_waves": cluster.refine_waves,
+        "per_job": int(cluster.per_job),
+    }
+    arrays = {
+        f"s_{name}": np.asarray(v)
+        for name, v in cluster.fetch_state().items()
+    }
+    if cluster.grouped:
+        got = {k: np.asarray(v) for k, v in cluster.groups._asdict().items()}
+        arrays.update({f"g_{name}": got[name] for name in _DEVICE_GROUPS})
+    if cluster.per_job:
+        arrays["job_unsched_cost"] = np.asarray(cluster.job_unsched_cost)
+    np.savez_compressed(
+        path,
+        __kind__=np.array("device_bulk"),
+        __meta__=np.array([meta[k] for k in sorted(meta)], np.int64),
+        __meta_keys__=np.array(sorted(meta)),
+        **arrays,
+    )
+
+
+def load_device_checkpoint(path: str, class_cost_fn=None):
+    """Rebuild a DeviceBulkCluster from a device checkpoint. The cost
+    callback is code, not data — pass the same class_cost_fn the saved
+    cluster used (its identity shapes the compiled round programs)."""
+    import jax.numpy as jnp
+
+    from ..scheduler.device_bulk import DeviceBulkCluster, DeviceClusterState
+
+    data = np.load(path)
+    if "__kind__" not in data or str(data["__kind__"]) != "device_bulk":
+        raise ValueError(
+            f"{path} is not a device_bulk checkpoint (wrong kind or a "
+            "bulk/npz checkpoint — use load_bulk_checkpoint for those)"
+        )
+    meta = {
+        str(k): int(v)
+        for k, v in zip(data["__meta_keys__"], data["__meta__"])
+    }
+    if meta["version"] != CHECKPOINT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {meta['version']}")
+    cluster = DeviceBulkCluster(
+        num_machines=meta["num_machines"],
+        pus_per_machine=meta["pus_per_machine"],
+        slots_per_pu=meta["slots_per_pu"],
+        num_jobs=meta["num_jobs"],
+        num_task_classes=meta["num_task_classes"],
+        task_capacity=meta["task_capacity"],
+        unsched_cost=meta["unsched_cost"],
+        ec_cost=meta["ec_cost"],
+        class_cost_fn=class_cost_fn,
+        supersteps=meta["supersteps"],
+        decode_width=None if meta["decode_width"] < 0 else meta["decode_width"],
+        alpha=meta["alpha"],
+        job_unsched_cost=(
+            data["job_unsched_cost"] if meta["per_job"] else None
+        ),
+        preemption=bool(meta["preemption"]),
+        continuation_discount=meta["continuation_discount"],
+        num_groups=meta["num_groups"],
+        active_groups_cap=meta["active_groups_cap"],
+        refine_waves=meta["refine_waves"],
+    )
+    cluster.state = DeviceClusterState(
+        **{name: jnp.asarray(data[f"s_{name}"]) for name in _DEVICE_STATE}
+    )
+    if cluster.grouped:
+        cluster.set_groups(
+            **{name: data[f"g_{name}"] for name in _DEVICE_GROUPS}
+        )
+    return cluster

@@ -42,6 +42,7 @@ from ..solver.layered import (
     COST_SCALE_LIMIT,
     choose_eps0,
     pad_geometry,
+    solve_row_constant,
     split_grants_by_class,
     transport_fori,
     transport_fori_tiered,
@@ -202,19 +203,34 @@ class DeviceBulkCluster:
                 or bool((job_unsched_cost == job_unsched_cost[0]).all())
             )
         )
+        # Row-constant: each (job, class) row's cost is machine-uniform
+        # (per-job unsched costs but no class cost model) — rows differ
+        # from each other, so the class-degenerate collapse doesn't
+        # apply, but the fractional-knapsack closed form
+        # (solver/layered.py solve_row_constant) is exact. Without it
+        # the iterative solve herds pathologically at scale (the
+        # 12.5k-machine livelock of docs/NOTES.md, per-job flavor).
+        self.row_constant = (
+            not self.grouped and self.per_job and class_cost_fn is None
+        )
         # A positive continuation discount makes cells residency-
         # dependent, so the degenerate collapse only applies to
         # preemption mode at discount 0 (where the tiers coincide and
         # the ordinary solve serves).
         if self.preemption and self.continuation_discount > 0:
             self.class_degenerate = False
+            self.row_constant = False
         # Closed-form solves (G == 1 or degenerate) take no iterations;
         # otherwise the cost-scaling schedule runs under a
         # lax.while_loop that exits on convergence — this is only the
         # safety bound, not the cost.
         self.supersteps = int(
             supersteps if supersteps is not None
-            else (1 if (self.G == 1 or self.class_degenerate) else 16384)
+            else (
+                1
+                if (self.G == 1 or self.class_degenerate or self.row_constant)
+                else 16384
+            )
         )
 
         # Padded transport columns: [machines | zero-cap padding | unsched]
@@ -238,6 +254,12 @@ class DeviceBulkCluster:
             u=jnp.full(self.G, self.unsched_cost, jnp.int32),
             pref_w=jnp.full((self.G, self.M), PREF_NONE, jnp.int32),
         ) if self.grouped else None
+        # Host mirror of GroupSpec.cls so group-only admissions can
+        # derive per-task classes without a device fetch (which would
+        # poison dispatch latency on tunneled TPUs — docs/NOTES.md).
+        self._groups_cls_host = (
+            np.zeros(self.G, np.int32) if self.grouped else None
+        )
         self._build_programs()
         self.last_stats: Optional[dict] = None
         self.last_admitted = None  # device i32 from the latest add_tasks
@@ -260,6 +282,7 @@ class DeviceBulkCluster:
         grouped = self.grouped
         active_cap = self.active_groups_cap
         class_degenerate = self.class_degenerate
+        row_constant = self.row_constant
         preempt, discount = self.preemption, self.continuation_discount
         refine_waves = self.refine_waves
         # Per-row (group) escape costs: row g = j*C + c escapes at job
@@ -509,7 +532,12 @@ class DeviceBulkCluster:
             # pathology — measured 20x SLOWER (9ms -> 197ms/round on the
             # CoCo 50k config) than cold tightening, which re-derives
             # prices from the cost structure each round.
-            if not grouped:
+            if row_constant:
+                # machine-uniform rows (per-job unsched, no cost model):
+                # the fractional-knapsack closed form — no iterations
+                y = solve_row_constant(w[:, 0], supply, col_cap)
+                solve_steps, converged = i32(0), jnp.bool_(True)
+            elif not grouped:
                 # eps0 = n_scale/16: measured ~5x fewer supersteps than
                 # starting at one original cost unit on contended
                 # interference-model instances, still exactly optimal
@@ -757,7 +785,12 @@ class DeviceBulkCluster:
             eps0 = choose_eps0(
                 n_scale, eps_full, total, jnp.sum(col_cap_m)
             )
-            if discount == 0:
+            if discount == 0 and row_constant:
+                # tiers coincide AND rows are machine-uniform: the
+                # fractional-knapsack closed form on the all-live supply
+                y = solve_row_constant(w[:, 0], supply, col_cap)
+                solve_steps, converged = i32(0), jnp.bool_(True)
+            elif discount == 0:
                 # tiers coincide: the ordinary solve (incl. the
                 # degenerate collapse) is exact on the all-live supply
                 y, _pm, solve_steps, converged = transport_fori(
@@ -986,6 +1019,26 @@ class DeviceBulkCluster:
                     f"{g.min()}..{g.max()}"
                 )
             grp[: len(g)] = g
+            # round_core's census feeds cost_fn from per-task cls, so
+            # grouped admissions must carry classes consistent with the
+            # group table: derive them when omitted, validate otherwise.
+            derived = self._groups_cls_host[g]
+            if classes is None:
+                cls[: len(g)] = derived
+            else:
+                got = np.asarray(classes, np.int32)
+                if len(got) < len(g):
+                    raise ValueError(
+                        f"classes ({len(got)}) shorter than groups "
+                        f"({len(g)}): every grouped task needs both"
+                    )
+                got = got[: len(g)]
+                if (got != derived).any():
+                    bad = int(np.nonzero(got != derived)[0][0])
+                    raise ValueError(
+                        f"task {bad}: class {got[bad]} inconsistent with "
+                        f"group {g[bad]}'s class {derived[bad]}"
+                    )
         self.state, self.last_admitted = self._admit_jit(
             self.state, jnp.asarray(jobs), jnp.asarray(cls),
             jnp.asarray(grp), jnp.int32(count)
@@ -1043,6 +1096,8 @@ class DeviceBulkCluster:
             u=_vec("u", u, self.groups.u),
             pref_w=pw,
         )
+        if cls is not None:
+            self._groups_cls_host = np.asarray(cls, np.int32).copy()
 
     def complete_tasks(self, rows) -> None:
         pad = np.full(self.Tcap, self.Tcap, np.int32)

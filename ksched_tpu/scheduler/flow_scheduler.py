@@ -184,7 +184,18 @@ class FlowScheduler:
         self._execute_task(td, rd)
 
     def handle_task_eviction(self, td: TaskDescriptor, rd: ResourceDescriptor) -> None:
-        """Reference: flowscheduler/scheduler.go:231-246."""
+        """Reference: flowscheduler/scheduler.go:231-246.
+
+        Externally driven evictions are fenced like the other
+        placement-mutating events: an eviction during an in-flight
+        pipelined round would unbind a task the dispatched snapshot
+        still maps, letting _finish_round decode a stale PLACE for it.
+        Internal callers (delta application, deregister's evict-DFS)
+        run after the latch clears and use _evict_task directly."""
+        self._check_not_in_flight("handle_task_eviction")
+        self._evict_task(td, rd)
+
+    def _evict_task(self, td: TaskDescriptor, rd: ResourceDescriptor) -> None:
         rid = resource_id_from_string(rd.uuid)
         self.gm.task_evicted(td.uid, rid)
         if not self._unbind_task_from_resource(td, rid):
@@ -380,7 +391,7 @@ class FlowScheduler:
                 self.handle_task_placement(td, rs.descriptor)
                 num_scheduled += 1
             elif d.type == DeltaType.PREEMPT:
-                self.handle_task_eviction(td, rs.descriptor)
+                self._evict_task(td, rs.descriptor)
             elif d.type == DeltaType.MIGRATE:
                 self.handle_task_migration(td, rs.descriptor)
             elif d.type == DeltaType.NOOP:
@@ -463,7 +474,7 @@ class FlowScheduler:
         for task_id in list(self.resource_bindings.get(rid, ())):
             td = self.task_map.find(task_id)
             assert td is not None, f"descriptor for task {task_id} must exist"
-            self.handle_task_eviction(td, rd)
+            self._evict_task(td, rd)
 
     def _dfs_clean_up_resource(self, rtnd: ResourceTopologyNodeDescriptor) -> None:
         for child in rtnd.children:

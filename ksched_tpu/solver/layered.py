@@ -583,6 +583,67 @@ def transport_fori_tiered(wLo, wHi, R, supply, col_cap, num_supersteps: int,
     return lax.cond(conv1, keep, retry, operand=None)
 
 
+def solve_row_constant(v, supply, col_cap):
+    """EXACT closed form when every row's cost is machine-uniform:
+    w[g, m] = v[g] for all real columns m (the per-job-unsched shape
+    with no class cost model — each (job, class) row's shifted cost is
+    e - u_job everywhere). The objective sum_g v_g * placed_g is linear
+    in per-row placement totals, so the optimum is the fractional-
+    knapsack greedy: rows in ascending v (most profitable first), rows
+    with v >= 0 place nothing (ties at 0 left unscheduled, matching
+    solve_single_class), machine split arbitrary — assigned in
+    (row-order, machine-order) interval overlaps, mirroring
+    split_grants_by_class. Generalizes the class-degenerate collapse
+    (all rows equal) to rows equal only WITHIN themselves; the
+    iterative solve herds pathologically on such instances (a trivially
+    easy 12.5k-machine per-job instance blew a 20k-superstep budget —
+    docs/NOTES.md).
+
+    v int32[G]; supply int32[G]; col_cap int32[Mp1] (last = escape).
+    Returns y int32[G, Mp1] with the escape column filled.
+    """
+    i32 = jnp.int32
+    cap_real = col_cap[:-1]
+    cap_total = jnp.sum(cap_real)
+    order = jnp.argsort(v)
+    v_s = v[order]
+    sup_s = supply[order]
+    take_s = jnp.where(v_s < 0, sup_s, i32(0))
+    excl = jnp.cumsum(take_s) - take_s
+    q_s = jnp.clip(cap_total - excl, 0, take_s)  # placed per sorted row
+    Q = jnp.cumsum(q_s)
+    starts = Q - q_s
+    cum_m = jnp.cumsum(cap_real)
+    lo = jnp.maximum((cum_m - cap_real)[None, :], starts[:, None])
+    hi = jnp.minimum(cum_m[None, :], Q[:, None])
+    y_s = jnp.maximum(hi - lo, 0).astype(i32)  # [G, M] sorted rows
+    inv = jnp.argsort(order)
+    y_real = y_s[inv]
+    esc = (supply - jnp.sum(y_real, axis=1)).astype(i32)
+    return jnp.concatenate([y_real, esc[:, None]], axis=1)
+
+
+def solve_row_constant_np(v, supply, col_cap):
+    """Host (numpy) twin of solve_row_constant."""
+    cap_real = col_cap[:-1].astype(np.int64)
+    cap_total = int(cap_real.sum())
+    order = np.argsort(v, kind="stable")
+    sup_s = supply[order].astype(np.int64)
+    take_s = np.where(v[order] < 0, sup_s, 0)
+    excl = np.cumsum(take_s) - take_s
+    q_s = np.clip(cap_total - excl, 0, take_s)
+    Q = np.cumsum(q_s)
+    starts = Q - q_s
+    cum_m = np.cumsum(cap_real)
+    lo = np.maximum((cum_m - cap_real)[None, :], starts[:, None])
+    hi = np.minimum(cum_m[None, :], Q[:, None])
+    y_s = np.maximum(hi - lo, 0)
+    y_real = np.empty_like(y_s)
+    y_real[order] = y_s
+    esc = supply.astype(np.int64) - y_real.sum(axis=1)
+    return np.concatenate([y_real, esc[:, None]], axis=1)
+
+
 def solve_single_class(w, supply, col_cap):
     """EXACT closed form for the C=1 transportation row (the trivial
     cost model's shape, and the Google-trace / quincy-base shape): sort
@@ -871,6 +932,15 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
         # iterative solve herds pathologically on identical costs.
         y_tot = solve_single_class_np(wP[0], total, col_cap)
         y_np = split_grants_by_class(y_tot, supply)
+        steps_taken = 0
+    elif (w == w[:, :1]).all():
+        # Row-constant (each row machine-uniform, rows differ — the
+        # per-job-unsched shape with no class cost model): the
+        # fractional-knapsack closed form.
+        y_np = solve_row_constant_np(
+            w[:, 0].astype(np.int32), supply.astype(np.int32),
+            col_cap.astype(np.int32),
+        )
         steps_taken = 0
     else:
         wS = jnp.asarray((wP * n_scale).astype(np.int32))

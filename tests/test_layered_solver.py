@@ -147,6 +147,96 @@ def test_layered_prefers_cheap_machines():
     assert sorted(machines.tolist()) == [0, 0, 1, 1]
 
 
+@pytest.mark.parametrize("seed", range(5))
+def test_row_constant_closed_form_matches_iterative(seed):
+    """solve_row_constant (the per-job-unsched closed form) must match
+    the iterative cost-scaling solve's objective on random row-constant
+    instances — and the host solve_layered dispatch must take it
+    (supersteps == 0)."""
+    import jax.numpy as jnp
+
+    from ksched_tpu.solver.layered import (
+        _solve_transport,
+        pad_geometry,
+        solve_row_constant_np,
+    )
+
+    rng = np.random.default_rng(seed)
+    G, M = 6, 10
+    v = rng.integers(-12, 6, G).astype(np.int32)  # mixed signs
+    supply = rng.integers(0, 30, G).astype(np.int32)
+    cap = rng.integers(0, 12, M).astype(np.int32)
+    Mp, n_scale = pad_geometry(M, G)
+    col_cap = np.zeros(Mp, np.int32)
+    col_cap[:M] = cap
+    col_cap[-1] = supply.sum()
+
+    y = solve_row_constant_np(v, supply, col_cap)
+    # feasibility
+    assert (y >= 0).all()
+    assert (y.sum(axis=1) == supply).all()
+    assert (y[:, :-1].sum(axis=0) <= col_cap[:-1]).all()
+    obj = int((v.astype(np.int64)[:, None] * y[:, :-1]).sum())
+
+    # iterative exact solve on the same (machine-uniform) instance
+    wP = np.zeros((G, Mp), np.int64)
+    wP[:, :M] = v[:, None]
+    eps_full = int(max(1, np.abs(wP).max() * n_scale))
+    y2, _pm, steps, conv = _solve_transport(
+        jnp.asarray((wP * n_scale).astype(np.int32)),
+        jnp.asarray(supply), jnp.asarray(col_cap),
+        jnp.int32(eps_full), None, alpha=8, max_supersteps=1 << 16,
+    )
+    assert bool(conv)
+    obj2 = int((wP[:, :M] * np.asarray(y2, np.int64)[:, :M]).sum())
+    assert obj == obj2
+
+    # dispatch: solve_layered_host must hit the closed form
+    solver = LayeredTransportSolver()
+    res = solver.solve_layered(
+        LayeredProblem(
+            supply=supply, col_cap=cap,
+            cost_cm=np.zeros((G, M), np.int32),
+            unsched_cost=0, ec_cost=0,
+            row_unsched_cost=-v.astype(np.int64),
+        )
+    )
+    assert res.supersteps == 0
+    # res.objective is in full-graph units (u*unplaced + (e+cost)*y);
+    # here cost = e = 0, so it is exactly the escape charges — and the
+    # shifted objective (v * placed) must equal the iterative solve's
+    unplaced_row = supply - res.y.sum(axis=1)
+    assert res.objective == int(
+        (-v.astype(np.int64) * unplaced_row).sum()
+    )
+    assert int((v.astype(np.int64)[:, None] * res.y).sum()) == obj
+
+
+def test_device_per_job_row_constant_closed_form():
+    """The device per-job path with distinct unsched costs and no cost
+    model must take the row-constant closed form (0 supersteps) and
+    prioritize the rows with the most expensive escapes."""
+    from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
+
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=1, num_jobs=3,
+        task_capacity=16, ec_cost=2,
+        job_unsched_cost=np.array([1, 10, 20]),
+    )
+    assert dev.row_constant and not dev.class_degenerate
+    assert dev.supersteps == 1
+    # 2 slots, 3 tasks: job-2 and job-1 tasks must win (escape dearest),
+    # job-0 stays (escape at 1 < EC cost 2)
+    dev.add_tasks(3, np.array([0, 1, 2], np.int32))
+    stats = dev.fetch_stats(dev.round())
+    assert bool(stats["converged"]) and int(stats["supersteps"]) == 0
+    st = {k: np.asarray(v) for k, v in dev.fetch_state().items()}
+    rows = np.nonzero(st["live"] & (st["pu"] >= 0))[0]
+    assert sorted(st["job"][rows].tolist()) == [1, 2]
+    # objective: job-0 escapes at 1; jobs 1,2 place at e=2 each
+    assert int(stats["objective"]) == 1 + 2 + 2
+
+
 def test_layered_unsched_when_placement_too_expensive():
     """Tasks stay unscheduled when u < e + cost (the escape-arc policy,
     reference trivial_cost_modeler.go:41-43)."""

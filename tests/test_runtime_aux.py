@@ -139,6 +139,117 @@ def test_bulk_checkpoint_roundtrip(tmp_path):
     assert c2.num_placed_tasks == placed_before + 6
 
 
+# -- checkpoint / resume (device path) ------------------------------------
+
+
+def _device_state_equal(a, b):
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k])) for k in a)
+
+
+def test_device_checkpoint_roundtrip_group_mode(tmp_path):
+    """A group-mode DeviceBulkCluster (the production Quincy path)
+    survives a restart: restored state is bit-identical, and the
+    restored cluster continues under churn in lockstep with the
+    original (same placements, same stats, same final state)."""
+    from ksched_tpu.costmodels.quincy_device import QuincyGroupTable
+    from ksched_tpu.runtime import load_device_checkpoint, save_device_checkpoint
+    from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
+
+    MB = 1 << 20
+    G, M = 16, 8
+    dev = DeviceBulkCluster(
+        num_machines=M, pus_per_machine=2, slots_per_pu=2, num_jobs=2,
+        task_capacity=128, num_groups=G, supersteps=1 << 14,
+    )
+    table = QuincyGroupTable(num_groups=G, num_machines=M)
+    rng = np.random.default_rng(5)
+    for b in range(1, 9):
+        table.blocks.register(
+            b, 64 * MB, rng.choice(M, size=2, replace=False).tolist()
+        )
+    blocks = rng.integers(1, 9, 40)
+    groups = table.groups_for(
+        np.zeros(40, np.int32), [[int(b)] for b in blocks]
+    )
+    table.sync(dev)
+    dev.add_tasks(40, rng.integers(0, 2, 40).astype(np.int32), groups=groups)
+    s = dev.fetch_stats(dev.round())
+    assert bool(s["converged"])
+
+    path = str(tmp_path / "dev.npz")
+    save_device_checkpoint(dev, path)
+    dev2 = load_device_checkpoint(path)
+
+    assert _device_state_equal(dev.fetch_state(), dev2.fetch_state())
+    # restart-under-churn parity: identical ops on both clusters from
+    # here on must produce identical rounds and identical final state
+    rng_ops = np.random.default_rng(11)
+    for _ in range(3):
+        st = dev.fetch_state()
+        placed = np.nonzero(np.asarray(st["live"]) & (np.asarray(st["pu"]) >= 0))[0]
+        done = rng_ops.choice(placed, size=min(5, len(placed)), replace=False)
+        nb = rng_ops.integers(1, 9, 4)
+        ng = table.groups_for(np.zeros(4, np.int32), [[int(b)] for b in nb])
+        nj = rng_ops.integers(0, 2, 4).astype(np.int32)
+        for d in (dev, dev2):
+            table.sync(d)
+            d.complete_tasks(done.astype(np.int32))
+            d.add_tasks(4, nj, groups=ng)
+            d.round()
+        sa = dev.fetch_stats()
+        sb = dev2.fetch_stats()
+        assert int(sa["placed"]) == int(sb["placed"])
+        assert int(sa["unscheduled"]) == int(sb["unscheduled"])
+    assert _device_state_equal(dev.fetch_state(), dev2.fetch_state())
+
+
+def test_device_checkpoint_roundtrip_preemption(tmp_path):
+    """Preemption mode: residency (continuation pricing) is part of the
+    state; the restored cluster must keep preempting identically."""
+    import jax.numpy as jnp
+
+    from ksched_tpu.runtime import load_device_checkpoint, save_device_checkpoint
+    from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
+
+    cost = np.random.default_rng(2).integers(0, 12, (2, 6)).astype(np.int32)
+    cost_d = jnp.asarray(cost)
+
+    def cost_fn(census):
+        return cost_d
+
+    def make():
+        return DeviceBulkCluster(
+            num_machines=6, pus_per_machine=1, slots_per_pu=2, num_jobs=2,
+            num_task_classes=2, task_capacity=64, class_cost_fn=cost_fn,
+            preemption=True, continuation_discount=2, supersteps=1 << 14,
+        )
+
+    dev = make()
+    rng = np.random.default_rng(0)
+    dev.add_tasks(10, rng.integers(0, 2, 10).astype(np.int32),
+                  rng.integers(0, 2, 10).astype(np.int32))
+    s = dev.fetch_stats(dev.round())
+    assert bool(s["converged"])
+
+    path = str(tmp_path / "devp.npz")
+    save_device_checkpoint(dev, path)
+    dev2 = load_device_checkpoint(path, class_cost_fn=cost_fn)
+    assert dev2.preemption and dev2.continuation_discount == 2
+    assert _device_state_equal(dev.fetch_state(), dev2.fetch_state())
+
+    rng_ops = np.random.default_rng(3)
+    for _ in range(3):
+        nj = rng_ops.integers(0, 2, 3).astype(np.int32)
+        nc = rng_ops.integers(0, 2, 3).astype(np.int32)
+        for d in (dev, dev2):
+            d.add_tasks(3, nj, nc)
+            d.round()
+        sa, sb = dev.fetch_stats(), dev2.fetch_stats()
+        assert int(sa["placed"]) == int(sb["placed"])
+        assert int(sa["preempted"]) == int(sb["preempted"])
+    assert _device_state_equal(dev.fetch_state(), dev2.fetch_state())
+
+
 # -- tracing ---------------------------------------------------------------
 
 
