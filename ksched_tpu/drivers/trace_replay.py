@@ -165,6 +165,48 @@ def synthesize_trace(
     return machines, events
 
 
+def iter_windows(
+    task_events: Iterable[TraceTaskEvent],
+    window_s: float,
+    machine_events_until=None,
+    max_rounds: Optional[int] = None,
+) -> Iterator[Tuple[List[TraceTaskEvent], List[Tuple[int, int]]]]:
+    """Batch a timestamp-ordered task-event stream into scheduling
+    windows (the trace analogue of the reference's 2s pod-batch
+    debounce, k8sclient/client.go:153-193). Yields one
+    (submits, finish_keys) pair per non-empty window; calls
+    `machine_events_until(t_us)` before each yield so the caller can
+    drain machine events up to the window boundary. ONE definition
+    shared by the host and device replay drivers so their windowing
+    protocols cannot drift."""
+    window_us = int(window_s * 1e6)
+    pending_submit: List[TraceTaskEvent] = []
+    pending_finish: List[Tuple[int, int]] = []
+    window_end = None
+    rounds = 0
+    for ev in task_events:
+        if window_end is None:
+            window_end = ev.time_us + window_us
+            if machine_events_until is not None:
+                machine_events_until(ev.time_us)
+        while ev.time_us >= window_end:
+            if pending_submit or pending_finish:
+                if machine_events_until is not None:
+                    machine_events_until(window_end)
+                yield pending_submit, pending_finish
+                pending_submit, pending_finish = [], []
+                rounds += 1
+                if max_rounds is not None and rounds >= max_rounds:
+                    return
+            window_end += window_us
+        if ev.event_type == SUBMIT:
+            pending_submit.append(ev)
+        elif ev.event_type in (FINISH, KILL, FAIL, LOST, EVICT):
+            pending_finish.append((ev.job_id, ev.task_index))
+    if pending_submit or pending_finish:
+        yield pending_submit, pending_finish
+
+
 @dataclass
 class ReplayStats:
     rounds: int = 0
@@ -248,13 +290,8 @@ class TraceReplayDriver:
         import time as _time
 
         stats = ReplayStats()
-        window_us = int(window_s * 1e6)
-        pending_submit: List[TraceTaskEvent] = []
-        pending_finish: List[Tuple[int, int]] = []
-        window_end = None
 
-        def flush_window():
-            nonlocal pending_submit, pending_finish
+        def flush_window(pending_submit, pending_finish):
             t0 = _time.perf_counter()
             # Admit before retiring: a task can SUBMIT and FINISH inside
             # one window, and its finish must find the row just created.
@@ -281,23 +318,204 @@ class TraceReplayDriver:
             stats.round_latencies_s.append(_time.perf_counter() - t0)
             stats.placed += len(result.placed_tasks)
             stats.rounds += 1
-            pending_submit, pending_finish = [], []
 
-        for ev in task_events:
-            if window_end is None:
-                window_end = ev.time_us + window_us
-                self._apply_machine_events_until(ev.time_us, stats)
-            while ev.time_us >= window_end:
-                if pending_submit or pending_finish:
-                    self._apply_machine_events_until(window_end, stats)
-                    flush_window()
-                    if max_rounds is not None and stats.rounds >= max_rounds:
-                        return stats
-                window_end += window_us
-            if ev.event_type == SUBMIT:
-                pending_submit.append(ev)
-            elif ev.event_type in (FINISH, KILL, FAIL, LOST, EVICT):
-                pending_finish.append((ev.job_id, ev.task_index))
-        if pending_submit or pending_finish:
-            flush_window()
+        for submits, finishes in iter_windows(
+            task_events, window_s,
+            machine_events_until=lambda t: self._apply_machine_events_until(
+                t, stats
+            ),
+            max_rounds=max_rounds,
+        ):
+            flush_window(submits, finishes)
         return stats
+
+
+class DeviceTraceReplayDriver:
+    """Trace replay on the DEVICE-resident path at full trace scale.
+
+    The host TraceReplayDriver above round-trips device<->host every
+    window (admit, solve, fetch, complete) — honest on JAX-CPU,
+    unmeasurable over a tunneled TPU (docs/NOTES.md). This driver is
+    the TPU-idiomatic form: `stage()` batches the whole event stream
+    into fixed-width per-window arrays (admissions, completions,
+    machine toggles) and `replay()` hands them to
+    DeviceBulkCluster.run_replay_rounds, which scans all K rounds as
+    ONE device program — the reference's event loop
+    (cmd/k8sscheduler/scheduler.go:120-188) with the host round-trips
+    compiled away.
+
+    Row assignment is predicted by a HOST MIRROR of the live bitmap:
+    the device admit fills the first `count` free rows in ascending
+    row order (a deterministic rule), so the host can track
+    (job_id, task_index) -> row without ever fetching device state.
+
+    Policy: 4 task classes (the trace's scheduling_class domain) and
+    per-job unscheduled costs (graph_manager.go:1291-1305) — the
+    per-job row-constant shape, solved by the exact closed form."""
+
+    def __init__(
+        self,
+        machine_events: Iterable[TraceMachineEvent],
+        slots_per_machine: int = 8,
+        num_jobs_hint: int = 64,
+        task_capacity: int = 1 << 15,
+        decode_width: int = 4096,
+    ) -> None:
+        import jax.numpy as jnp
+
+        from ..scheduler.device_bulk import DeviceBulkCluster
+
+        self._machine_events = sorted(machine_events, key=lambda e: e.time_us)
+        self._machine_index: Dict[int, int] = {}
+        for ev in self._machine_events:
+            if ev.machine_id not in self._machine_index:
+                self._machine_index[ev.machine_id] = len(self._machine_index)
+        self.num_machines = len(self._machine_index)
+        self.num_jobs = num_jobs_hint
+        self.Tcap = int(task_capacity)
+        # distinct per-job escape costs (u_j > e = 0 so placement
+        # always profits): the row-constant per-job shape
+        job_u = 1 + (np.arange(num_jobs_hint, dtype=np.int64) % 8)
+        self.cluster = DeviceBulkCluster(
+            num_machines=self.num_machines,
+            pus_per_machine=1,
+            slots_per_pu=slots_per_machine,
+            num_jobs=num_jobs_hint,
+            num_task_classes=4,
+            task_capacity=self.Tcap,
+            ec_cost=0,
+            job_unsched_cost=job_u,
+            decode_width=decode_width,
+        )
+        assert self.cluster.row_constant, "trace policy must take the closed form"
+        # everything starts out of service; time-0 ADDs enable in stage()
+        self.cluster.state = self.cluster.state._replace(
+            machine_enabled=jnp.zeros(self.num_machines, jnp.bool_)
+        )
+
+    def stage(
+        self,
+        task_events: Iterable[TraceTaskEvent],
+        window_s: float = 5.0,
+        max_rounds: Optional[int] = None,
+    ) -> dict:
+        """Batch events into per-window arrays via the shared
+        iter_windows protocol; returns the schedule dict
+        run_replay_rounds takes, with staging metadata (rounds,
+        submits, finishes, toggles).
+
+        The device round applies toggles -> completions -> admissions,
+        so a task whose SUBMIT and FINISH land in the SAME window
+        cannot be expressed in one device round (its completion would
+        precede its admission); such finishes are deferred one window
+        — the task is admitted this round and completed the next,
+        preserving the submit/finish counts the host driver reports."""
+        live = np.zeros(self.Tcap, bool)  # host mirror of the live bitmap
+        row_of: Dict[Tuple[int, int], int] = {}
+        machine_cursor = 0
+
+        windows: List[dict] = []
+        pending_toggles: Dict[int, bool] = {}  # dedup keep-last per window
+        carry_finish: List[Tuple[int, int]] = []
+        submitted = finished = dropped = 0
+
+        def machine_events_until(t_us):
+            nonlocal machine_cursor
+            while (
+                machine_cursor < len(self._machine_events)
+                and self._machine_events[machine_cursor].time_us <= t_us
+            ):
+                ev = self._machine_events[machine_cursor]
+                machine_cursor += 1
+                idx = self._machine_index[ev.machine_id]
+                if ev.event_type == MACHINE_ADD:
+                    pending_toggles[idx] = True
+                elif ev.event_type == MACHINE_REMOVE:
+                    pending_toggles[idx] = False
+
+        def flush_window(submits, finishes):
+            nonlocal carry_finish, pending_toggles
+            nonlocal submitted, finished, dropped
+            # completions first in the mirror (matching the device
+            # round's order); finishes for tasks submitted in THIS
+            # window defer to the next one (see docstring)
+            submitted_keys = {(ev.job_id, ev.task_index) for ev in submits}
+            done_rows = []
+            deferred = []
+            for key in carry_finish + finishes:
+                row = row_of.pop(key, None)
+                if row is not None:
+                    done_rows.append(row)
+                    live[row] = False
+                elif key in submitted_keys:
+                    deferred.append(key)
+            carry_finish = deferred
+            finished += len(done_rows)
+            # admissions: first n free rows, ascending — the admit rule
+            free = np.nonzero(~live)[0]
+            n_adm = min(len(submits), len(free))
+            dropped += len(submits) - n_adm
+            rows = free[:n_adm]
+            adm = []
+            for ev, row in zip(submits[:n_adm], rows):
+                row_of[(ev.job_id, ev.task_index)] = int(row)
+                live[row] = True
+                adm.append(
+                    (ev.job_id % self.num_jobs, ev.scheduling_class % 4)
+                )
+            submitted += n_adm
+            windows.append(
+                dict(
+                    adm=adm,
+                    done=done_rows,
+                    toggles=sorted(pending_toggles.items()),
+                )
+            )
+            pending_toggles = {}
+
+        for submits, finishes in iter_windows(
+            task_events, window_s,
+            machine_events_until=machine_events_until,
+            max_rounds=max_rounds,
+        ):
+            flush_window(submits, finishes)
+        if carry_finish and (max_rounds is None or len(windows) < max_rounds):
+            # trace ended with deferred same-window finishes: one extra
+            # completion-only window retires them
+            flush_window([], [])
+
+        K = len(windows)
+        Amax = max(1, max(len(w["adm"]) for w in windows))
+        Dmax = max(1, max(len(w["done"]) for w in windows))
+        Emax = max(1, max(len(w["toggles"]) for w in windows))
+        sch = {
+            "adm_job": np.zeros((K, Amax), np.int32),
+            "adm_cls": np.zeros((K, Amax), np.int32),
+            "adm_grp": np.zeros((K, Amax), np.int32),
+            "adm_n": np.zeros(K, np.int32),
+            "done_rows": np.full((K, Dmax), self.Tcap, np.int32),
+            "done_n": np.zeros(K, np.int32),
+            "tog_idx": np.zeros((K, Emax), np.int32),
+            "tog_on": np.zeros((K, Emax), bool),
+            "tog_n": np.zeros(K, np.int32),
+            "rounds": K,
+            "submitted": submitted,
+            "finished": finished,
+            "dropped": dropped,
+        }
+        for i, w in enumerate(windows):
+            sch["adm_n"][i] = len(w["adm"])
+            for j, (job, cls) in enumerate(w["adm"]):
+                sch["adm_job"][i, j] = job
+                sch["adm_cls"][i, j] = cls
+            sch["done_n"][i] = len(w["done"])
+            sch["done_rows"][i, : len(w["done"])] = w["done"]
+            sch["tog_n"][i] = len(w["toggles"])
+            for j, (idx, on) in enumerate(w["toggles"]):
+                sch["tog_idx"][i, j] = idx
+                sch["tog_on"][i, j] = on
+        return sch
+
+    def replay(self, schedule: dict, seed: int = 0):
+        """Run a staged schedule; returns un-fetched stacked stats."""
+        return self.cluster.run_replay_rounds(schedule, seed=seed)

@@ -280,6 +280,11 @@ class DeviceBulkCluster:
         i32 = jnp.int32
         per_job, Gn = self.per_job, self.G
         grouped = self.grouped
+        # The one-hot decode's [W, Gn] x [Gn, M] matmuls scale as
+        # W*Gn*M MACs; beyond ~2M Gn*M cells the sort+row-gather decode
+        # wins regardless of mode (e.g. per-job rows at trace scale:
+        # 256 groups x 12.5k machines). Static choice per geometry.
+        use_sorted_decode = grouped or (Gn * M >= (1 << 21))
         active_cap = self.active_groups_cap
         class_degenerate = self.class_degenerate
         row_constant = self.row_constant
@@ -538,11 +543,11 @@ class DeviceBulkCluster:
                 y = solve_row_constant(w[:, 0], supply, col_cap)
                 solve_steps, converged = i32(0), jnp.bool_(True)
             elif not grouped:
-                # eps0 = n_scale/16: measured ~5x fewer supersteps than
-                # starting at one original cost unit on contended
-                # interference-model instances, still exactly optimal
-                # (any eps0 is valid off tightened potentials; the
-                # in-graph fallback to the full schedule covers
+                # eps0 from choose_eps0 (n_scale/4 — see the round-3
+                # tail study in default_eps0's docstring: deeply
+                # sub-quantum starts cause multi-thousand-superstep
+                # tail rounds; exactly optimal for any start, with the
+                # in-graph fallback to the full schedule covering
                 # pathologies). Oversubscribed rounds (backlog > free
                 # slots) switch to the full-range start — choose_eps0.
                 eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
@@ -671,7 +676,8 @@ class DeviceBulkCluster:
             y_real = y[:, :M]
 
             # ---- decode: rank-match placed tasks to machine grants ----
-            decode = rank_match_decode_grouped if grouped else rank_match_decode
+            decode = (rank_match_decode_grouped if use_sorted_decode
+                      else rank_match_decode)
             placed_w, pu_abs = decode(g_safe, y_real, pu_free)
 
             if idx is None:
@@ -826,7 +832,8 @@ class DeviceBulkCluster:
             stay_pu = jnp.where(stay, cur_pu, num_pus)
             pu_stay = jnp.zeros(num_pus + 1, i32).at[stay_pu].add(1)[:num_pus]
             pu_free_mv = jnp.where(enabled_pu, i32(S) - pu_stay, i32(0))
-            decode = rank_match_decode_grouped if grouped else rank_match_decode
+            decode = (rank_match_decode_grouped if use_sorted_decode
+                      else rank_match_decode)
             granted, pu_abs = decode(g_mv, rem, pu_free_mv)
 
             new_pu = jnp.where(
@@ -973,6 +980,93 @@ class DeviceBulkCluster:
             stats["completed"] = jnp.sum(done, dtype=i32)
             stats["admitted"] = admitted
             return state, stats
+
+        def replay_round(state, gspec, xs):
+            """One trace-replay round: machine toggles (with evictions),
+            completions, admissions, then the scheduling round — the
+            whole round's events pre-staged as fixed-width device
+            arrays so a windowed trace replays as ONE scanned program
+            (the TPU-idiomatic form of the reference's event loop,
+            cmd/k8sscheduler/scheduler.go:120-188: host batches events
+            into windows ahead of time, device consumes them without
+            per-round host round-trips)."""
+            aj, ac, ag, an, dr, dn, ti, ton, tn, key = xs
+            Emax = ti.shape[0]
+            Dmax = dr.shape[0]
+            Amax = aj.shape[0]
+
+            # --- machine toggles + evictions (set_machine, batched;
+            # the host stager dedups per-window toggles keep-last, so
+            # duplicate scatter indices cannot race) ---
+            valid_t = jnp.arange(Emax, dtype=i32) < tn
+            idx_t = jnp.where(valid_t, ti, i32(M))
+            me = state.machine_enabled.at[idx_t].set(ton, mode="drop")
+            on = state.live & (state.pu >= 0)
+            machine_of = jnp.clip(state.pu, 0, num_pus - 1) // P
+            evict = on & ~me[machine_of]
+            pu2 = jnp.where(evict, i32(-1), state.pu)
+            on2 = state.live & (pu2 >= 0)
+            pu_idx = jnp.where(on2, pu2, num_pus)
+            pu_running = jnp.zeros(num_pus + 1, i32).at[pu_idx].add(1)[:num_pus]
+            state = state._replace(
+                machine_enabled=me, pu=pu2, pu_running=pu_running
+            )
+            evicted = jnp.sum(evict, dtype=i32)
+
+            # --- completions (complete(), in-scan form) ---
+            kk = jnp.arange(Dmax, dtype=i32)
+            idx_d = jnp.where(kk < dn, dr, i32(Tcap))
+            done = (
+                jnp.zeros(Tcap + 1, jnp.bool_).at[idx_d].set(True)[:Tcap]
+                & state.live
+            )
+            pu_idx = jnp.where(done & (state.pu >= 0), state.pu, num_pus)
+            dec = jnp.zeros(num_pus + 1, i32).at[pu_idx].add(1)[:num_pus]
+            state = state._replace(
+                live=state.live & ~done,
+                pu=jnp.where(done, i32(-1), state.pu),
+                pu_running=state.pu_running - dec,
+            )
+
+            # --- admissions (admit(), [Amax]-wide sources; the host
+            # mirror predicts the same first-free-rows assignment) ---
+            free_rank = jnp.cumsum(~state.live) - 1
+            newmask = ~state.live & (free_rank < an)
+            src = jnp.clip(free_rank, 0, Amax - 1)
+            state = state._replace(
+                live=state.live | newmask,
+                cls=jnp.where(newmask, ac[src], state.cls),
+                job=jnp.where(newmask, aj[src], state.job),
+                grp=jnp.where(newmask, ag[src], state.grp),
+                pu=jnp.where(newmask, i32(-1), state.pu),
+            )
+            admitted = jnp.sum(newmask, dtype=i32)
+
+            if preempt:
+                state, stats = round_core_preempt(state, gspec)
+            else:
+                state, stats = round_core(
+                    state, gspec,
+                    decode_width=steady_decode_width,
+                    window_offset=jax.random.randint(key, (), 0, 1 << 30),
+                )
+            stats["evicted"] = evicted
+            stats["admitted"] = admitted
+            stats["completed"] = jnp.sum(done, dtype=i32)
+            return state, stats
+
+        def replay_scan(state, gspec, aj, ac, ag, an, dr, dn, ti, ton, tn,
+                        key0):
+            keys = jax.random.split(key0, aj.shape[0])
+
+            def body(s, xs):
+                return replay_round(s, gspec, xs)
+
+            return lax.scan(
+                body, state, (aj, ac, ag, an, dr, dn, ti, ton, tn, keys)
+            )
+
+        self._replay_scan_jit = jax.jit(replay_scan)
 
         core = round_core_preempt if preempt else round_core
         self._round_jit = jax.jit(core)
@@ -1131,6 +1225,29 @@ class DeviceBulkCluster:
             jnp.float32(churn_prob),
             int(arrivals),
             int(num_rounds),
+        )
+        self.last_stats = stats
+        return stats
+
+    def run_replay_rounds(self, schedule, seed: int = 0):
+        """Replay `schedule` (a staged window schedule — see
+        drivers/trace_replay.py DeviceTraceReplayDriver.stage) as one
+        scanned device program: K rounds of machine toggles +
+        completions + admissions + solve chained without host sync.
+        Returns stacked stats (device arrays, un-fetched)."""
+        self.state, stats = self._replay_scan_jit(
+            self.state,
+            self.groups,
+            jnp.asarray(schedule["adm_job"]),
+            jnp.asarray(schedule["adm_cls"]),
+            jnp.asarray(schedule["adm_grp"]),
+            jnp.asarray(schedule["adm_n"]),
+            jnp.asarray(schedule["done_rows"]),
+            jnp.asarray(schedule["done_n"]),
+            jnp.asarray(schedule["tog_idx"]),
+            jnp.asarray(schedule["tog_on"]),
+            jnp.asarray(schedule["tog_n"]),
+            jax.random.PRNGKey(seed),
         )
         self.last_stats = stats
         return stats

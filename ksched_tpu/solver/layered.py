@@ -159,11 +159,27 @@ COST_SCALE_LIMIT = 1 << 29
 
 def default_eps0(n_scale: int) -> int:
     """The tuned eps-schedule start for iterative transport solves:
-    n_scale/16, measured ~5x fewer supersteps than one original cost
-    unit (n_scale) on contended interference instances, itself ~20x
-    better than max|w|. Valid for any value — tightened potentials make
-    the zero flow 0-optimal regardless; callers keep a full-range
-    fallback. One definition so the three solve sites cannot drift.
+    n_scale/4 — a quarter of one original cost unit. Valid for any
+    value — tightened potentials make the zero flow 0-optimal
+    regardless; callers keep a full-range fallback. One definition so
+    the three solve sites cannot drift.
+
+    Measured (round-3 tail study, tools/tail_repro.py on captured
+    steady-state whare + coco tail rounds): deeply sub-quantum starts
+    are the tail's CAUSE — at eps << one cost unit the synchronous
+    maximal pushes circulate flow around admissible cycles whose total
+    reduced cost sits between -len*eps and 0, with prices inching down
+    one eps per failed push (traced: 7k steps with excess sloshing
+    rows<->cols through 1-3 active columns and near-zero relabels).
+    The old n_scale/16 start burned 2.5-7k supersteps per contended
+    round; the superstep count is invariant to n_scale at a FIXED
+    eps0/n_scale ratio (measured: 64x n_scale change, identical
+    counts), so the ratio is the knob. The landscape is jagged and
+    regime-dependent (whare tails prefer 1.0: mean 934; coco tails
+    prefer 1/4: mean 419), but 1/4 has the best combined worst case —
+    max 1756 supersteps over every captured tail instance vs 3270 for
+    1.0 and 7136 for 1/16 — and is alpha-insensitive (a4 == a8 within
+    noise). Objectives identical across all starts, as theory demands.
 
     Only correct for instances that are NOT oversubscribed: when total
     supply exceeds real machine capacity, prices must descend deep on
@@ -171,7 +187,7 @@ def default_eps0(n_scale: int) -> int:
     eps-sized relabels (measured 1387 vs 284 supersteps on a 3x16 toy
     at 1.25x oversubscription). Use choose_eps0 where supply/capacity
     are at hand."""
-    return max(1, n_scale // 16)
+    return max(1, n_scale // 4)
 
 
 def choose_eps0(n_scale: int, eps_full, supply_total, real_cap_total):

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Numpy step-by-step replication of the transport superstep loop, for
+inspecting the dynamics of tail rounds (what are 5000 supersteps doing?).
+Mirrors solver/layered.py transport_superstep/_transport_loop exactly;
+parity with the JAX solver is asserted on the final objective."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+BIG = np.int64(1 << 30)
+BIG_D = np.int64(1 << 28)
+
+
+def excesses(supply, y, z):
+    e_row = supply - y.sum(axis=1)
+    e_col = y.sum(axis=0) - z
+    e_sink = z.sum() - supply.sum()
+    return e_row, e_col, e_sink
+
+
+def tighten(wS, U, col_cap):
+    live = col_cap > 0
+    pm = np.where(live, 0, -BIG_D)
+    has_arc = U > 0
+    pr = np.max(np.where(has_arc, pm[None, :] - wS, -BIG), axis=1)
+    pr = np.where(has_arc.any(axis=1), pr, 0)
+    psink = np.min(np.where(live, pm, BIG))
+    return pr, pm, psink
+
+
+def saturate_eps(wS, U, col_cap, y, z, pr, pm, psink, eps):
+    rcf = wS + pr[:, None] - pm[None, :]
+    y2 = np.where(rcf < -eps, U, np.where(rcf > eps, 0, y))
+    rcs = pm - psink
+    z2 = np.where(rcs < -eps, col_cap, np.where(rcs > eps, 0, z))
+    return y2, z2
+
+
+def price_refine(wS, U, col_cap, y, z, pr, pm, psink, eps, waves):
+    for _ in range(waves):
+        bound_m = np.min(np.where(U - y > 0, wS + pr[:, None] + eps, BIG), axis=0)
+        pm2 = np.maximum(np.minimum(pm, bound_m), -BIG_D)
+        pm2 = np.minimum(pm2, np.where(z > 0, psink + eps, BIG))
+        bound_r = np.min(np.where(y > 0, pm2[None, :] - wS + eps, BIG), axis=1)
+        pr2 = np.maximum(np.minimum(pr, bound_r), -BIG_D)
+        bound_s = np.min(np.where(col_cap - z > 0, pm2 + eps, BIG))
+        psink2 = np.maximum(np.minimum(psink, bound_s), -BIG_D)
+        pr, pm, psink = pr2, pm2, psink2
+    return pr, pm, psink
+
+
+def superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps, stats=None):
+    e_row, e_col, e_sink = excesses(supply, y, z)
+    rcf = wS + pr[:, None] - pm[None, :]
+
+    r_fwd = U - y
+    adm_f = (r_fwd > 0) & (rcf < 0)
+    r_adm = np.where(adm_f, r_fwd, 0)
+    excl = np.cumsum(r_adm, axis=1) - r_adm
+    delta_f = np.clip(e_row[:, None] - excl, 0, r_adm)
+
+    r_s = col_cap - z
+    rc_s = pm - psink
+    r_b = y
+    rc_b = pm[None, :] - pr[:, None] - wS
+    colA = np.concatenate(
+        [np.where((r_s > 0) & (rc_s < 0), r_s, 0)[None, :],
+         np.where((r_b > 0) & (rc_b < 0), r_b, 0)], axis=0)
+    exclA = np.cumsum(colA, axis=0) - colA
+    deltaA = np.clip(e_col[None, :] - exclA, 0, colA)
+    delta_s = deltaA[0]
+    delta_b = deltaA[1:]
+
+    r_zb = z
+    rc_zb = psink - pm
+    zb_adm = np.where((r_zb > 0) & (rc_zb < 0), r_zb, 0)
+    excl_zb = np.cumsum(zb_adm) - zb_adm
+    delta_zb = np.clip(e_sink - excl_zb, 0, zb_adm)
+
+    y2 = y + delta_f - delta_b
+    z2 = z + delta_s - delta_zb
+
+    pushed_row = delta_f.sum(axis=1)
+    cand_row = np.where(r_fwd > 0, pm[None, :] - wS, -BIG)
+    best_row = cand_row.max(axis=1)
+    relabel_row = (e_row > 0) & (pushed_row == 0)
+    pr2 = np.where(relabel_row, best_row - eps, pr)
+
+    pushed_col = delta_s + delta_b.sum(axis=0)
+    cand_col = np.maximum(
+        np.max(np.where(y > 0, pr[:, None] + wS, -BIG), axis=0),
+        np.where(r_s > 0, psink, -BIG))
+    relabel_col = (e_col > 0) & (pushed_col == 0)
+    pm2 = np.where(relabel_col, cand_col - eps, pm)
+
+    pushed_sink = delta_zb.sum()
+    cand_sink = np.max(np.where(z > 0, pm, -BIG))
+    relabel_sink = (e_sink > 0) & (pushed_sink == 0)
+    psink2 = np.where(relabel_sink, cand_sink - eps, psink)
+
+    if stats is not None:
+        stats.append(dict(
+            pushed=int(delta_f.sum() + delta_s.sum() + delta_b.sum() + delta_zb.sum()),
+            relabels_r=int(relabel_row.sum()), relabels_c=int(relabel_col.sum()),
+            excess_r=int(np.maximum(e_row, 0).sum()),
+            excess_c=int(np.maximum(e_col, 0).sum()),
+            e_sink=int(e_sink),
+            active_c=int((e_col > 0).sum()),
+        ))
+    return y2, z2, pr2, pm2, np.int64(psink2)
+
+
+def run(wS, supply, col_cap, eps_sched, refine_waves=8, verbose_every=500,
+        max_steps=40000):
+    U = np.minimum(supply[:, None], col_cap[None, :]).astype(np.int64)
+    pr, pm, psink = tighten(wS, U, col_cap)
+    C, Mp1 = wS.shape
+    y = np.zeros((C, Mp1), np.int64)
+    z = np.zeros(Mp1, np.int64)
+    tot = 0
+    for phase, eps in enumerate(eps_sched):
+        if refine_waves and phase > 0:
+            pr, pm, psink = price_refine(wS, U, col_cap, y, z, pr, pm, psink,
+                                         eps, refine_waves)
+        y, z = saturate_eps(wS, U, col_cap, y, z, pr, pm, psink,
+                            0 if phase == 0 else eps)
+        k = 0
+        stats = []
+        while True:
+            er, ec, es = excesses(supply, y, z)
+            if not (er > 0).any() and not (ec > 0).any() and es <= 0:
+                break
+            y, z, pr, pm, psink = superstep(wS, U, supply, col_cap, y, z,
+                                            pr, pm, psink, eps, stats)
+            k += 1
+            tot += 1
+            if verbose_every and k % verbose_every == 0:
+                s = stats[-1]
+                print(f"  eps={eps} step {k}: {s}")
+            if k > max_steps:
+                print("  STALL")
+                return y, z, tot
+        if stats:
+            pushes = sum(s["pushed"] for s in stats)
+            print(f"phase eps={eps}: {k} steps, {pushes} unit-pushes, "
+                  f"final excess drained")
+    return y, z, tot
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inst", default="/tmp/tails_whare.npz")
+    ap.add_argument("--k", type=int, default=0)
+    ap.add_argument("--n-scale", type=int, default=1024)
+    ap.add_argument("--eps0", type=int, default=None)
+    ap.add_argument("--alpha", type=int, default=8)
+    ap.add_argument("--refine", type=int, default=8)
+    ap.add_argument("--every", type=int, default=500)
+    args = ap.parse_args()
+
+    data = np.load(args.inst)
+    Mp = int(data["Mp"])
+    w = data[f"w_{args.k}"].astype(np.int64)
+    supply = data[f"supply_{args.k}"].astype(np.int64)
+    col_cap = data[f"colcap_{args.k}"].astype(np.int64)
+    C, M = w.shape
+    wP = np.zeros((C, Mp), np.int64)
+    wP[:, :M] = w
+    wS = wP * args.n_scale
+    eps0 = args.eps0 if args.eps0 is not None else max(1, args.n_scale // 16)
+    sched = []
+    e = eps0
+    while True:
+        sched.append(e)
+        if e <= 1:
+            break
+        e = max(1, e // args.alpha)
+    print(f"instance {args.k}: supply={supply.tolist()} "
+          f"cap={int(col_cap[:M].sum())} sched={sched}")
+    y, z, tot = run(wS, supply, col_cap, sched, refine_waves=args.refine,
+                    verbose_every=args.every)
+    obj = int((y[:, :M] * wP[:, :M]).sum())
+    print(f"total steps={tot} obj={obj} placed={int(y[:, :M].sum())}")

@@ -66,6 +66,28 @@ def build_config(name: str):
             unsched_cost=_coco_unsched(), ec_cost=0,
             supersteps=1 << 17, decode_width=4096,
         )
+    elif name == "quincy":
+        from ksched_tpu.costmodels.quincy_device import QuincyGroupTable
+
+        MBv = 1 << 20
+        tasks, machines, n_blocks, G = 10_000, 1_000, 480, 512
+        dev = DeviceBulkCluster(
+            num_machines=machines, pus_per_machine=4, slots_per_pu=4,
+            num_jobs=10, task_capacity=next_pow2(tasks + 4096),
+            num_groups=G, supersteps=1 << 17, decode_width=2048,
+        )
+        table = QuincyGroupTable(num_groups=G, num_machines=machines)
+        for b in range(1, n_blocks + 1):
+            table.blocks.register(
+                b, 512 * MBv,
+                rng.choice(machines, size=3, replace=False).tolist(),
+            )
+        blocks = rng.integers(1, n_blocks + 1, tasks)
+        groups = table.groups_for(
+            np.zeros(tasks, np.int32), [[int(b)] for b in blocks]
+        )
+        table.sync(dev)
+        dev._tail_repro_groups = (table, groups)  # capture() hooks
     else:
         raise SystemExit(f"unknown config {name!r}")
     return dev, tasks
@@ -89,11 +111,19 @@ def capture(args) -> None:
 
     dev, tasks = build_config(args.config)
     rng = np.random.default_rng(0)
-    dev.add_tasks(
-        tasks,
-        rng.integers(0, dev.J, tasks).astype(np.int32),
-        rng.integers(0, dev.C, tasks).astype(np.int32),
-    )
+    grouped_setup = getattr(dev, "_tail_repro_groups", None)
+    if grouped_setup is not None:
+        _table, init_groups = grouped_setup
+        dev.add_tasks(
+            tasks, rng.integers(0, dev.J, tasks).astype(np.int32),
+            groups=init_groups,
+        )
+    else:
+        dev.add_tasks(
+            tasks,
+            rng.integers(0, dev.J, tasks).astype(np.int32),
+            rng.integers(0, dev.C, tasks).astype(np.int32),
+        )
     jax.block_until_ready(dev.round())
 
     churn_n = max(1, int(tasks * 0.01))
@@ -130,11 +160,18 @@ def capture(args) -> None:
             placed_rows, size=min(churn_n, len(placed_rows)), replace=False
         )
         dev.complete_tasks(done.astype(np.int32))
-        dev.add_tasks(
-            churn_n,
-            rng.integers(0, dev.J, churn_n).astype(np.int32),
-            rng.integers(0, dev.C, churn_n).astype(np.int32),
-        )
+        if grouped_setup is not None:
+            dev.add_tasks(
+                churn_n,
+                rng.integers(0, dev.J, churn_n).astype(np.int32),
+                groups=rng.integers(0, dev.G, churn_n).astype(np.int32),
+            )
+        else:
+            dev.add_tasks(
+                churn_n,
+                rng.integers(0, dev.J, churn_n).astype(np.int32),
+                rng.integers(0, dev.C, churn_n).astype(np.int32),
+            )
         st = dev.fetch_state()
         stats = dev.fetch_stats(dev.round())
         ss = int(stats["supersteps"])
@@ -153,6 +190,26 @@ def capture(args) -> None:
     if not insts:
         print("no tail rounds captured; lower --threshold")
         return
+    if grouped_setup is not None:
+        # grouped instance: per-group supply over the decode window +
+        # machine_free; GroupSpec arrays are capture-static, saved once
+        out = {}
+        for k, (ss, st) in enumerate(insts):
+            supply, machine_free = grouped_instance_from_state(dev, st)
+            out[f"supply_{k}"] = supply
+            out[f"free_{k}"] = machine_free
+            out[f"ss_{k}"] = np.int64(ss)
+        g = dev.groups
+        out.update(
+            n=np.int64(len(insts)), n_scale=np.int64(dev.n_scale),
+            Mp=np.int64(dev.Mp), grouped=np.int64(1),
+            g_e=np.asarray(g.e), g_u=np.asarray(g.u),
+            g_pref=np.asarray(g.pref_w),
+            active_cap=np.int64(dev.active_groups_cap),
+        )
+        np.savez_compressed(args.out, **out)
+        print(f"wrote {len(insts)} grouped instances to {args.out}")
+        return
     # Reconstruct each tail round's transport instance from its
     # pre-round state snapshot. The captured state is PRE-churn; the
     # exact solved instance differs by one churn step, but the captured
@@ -170,6 +227,148 @@ def capture(args) -> None:
     out["Mp"] = np.int64(dev.Mp)
     np.savez_compressed(args.out, **out)
     print(f"wrote {len(insts)} instances to {args.out}")
+
+
+def grouped_instance_from_state(dev, st):
+    """(supply[G] over the decode window, machine_free[M]) for a
+    group-mode round — mirrors round_core's window census."""
+    live = np.asarray(st["live"])
+    pu = np.asarray(st["pu"])
+    grp = np.asarray(st["grp"])
+    M, P, S = dev.M, dev.P, dev.S
+    num_pus = dev.num_pus
+
+    placed = live & (pu >= 0)
+    pu_running = np.zeros(num_pus, np.int64)
+    np.add.at(pu_running, pu[placed], 1)
+    enabled = np.asarray(st["machine_enabled"])
+    pu_free = np.where(np.repeat(enabled, P), S - pu_running, 0)
+    machine_free = pu_free.reshape(M, P).sum(axis=1)
+
+    unplaced = live & (pu < 0)
+    W = dev.decode_width or dev.Tcap
+    rows = np.nonzero(unplaced)[0][:W]
+    supply = np.bincount(grp[rows], minlength=dev.G)
+    return supply.astype(np.int32), machine_free.astype(np.int32)
+
+
+def replay_grouped(args) -> None:
+    """Re-solve captured GROUPED instances under solver-strategy sweeps,
+    replicating round_core's grouped dispatch (two-stage decomposition
+    with the eps0=1 bounded attempt, active-row compaction, refined
+    full fallback — scheduler/device_bulk.py) outside the jitted round
+    so strategies can be compared on real blocked-contention rounds."""
+    import jax.numpy as jnp
+
+    from ksched_tpu.solver.layered import (
+        choose_eps0,
+        split_grants_by_class,
+        transport_fori,
+    )
+
+    data = np.load(args.inst)
+    n = int(data["n"])
+    n_scale = int(data["n_scale"])
+    Mp = int(data["Mp"])
+    e = data["g_e"].astype(np.int64)
+    u = data["g_u"].astype(np.int64)
+    pref = data["g_pref"].astype(np.int64)
+    G, M = pref.shape
+    PREF_NONE = 1 << 30
+
+    route = np.broadcast_to(e[:, None], (G, M))
+    cost_eff = np.minimum(route, pref)
+    w = cost_eff - u[:, None]
+    ground = (e - u).astype(np.int64)  # [G]
+
+    strategies = args.strategies.split(",")
+    active_cap = int(data["active_cap"])
+
+    for k in range(n):
+        supply = data[f"supply_{k}"].astype(np.int32)
+        machine_free = data[f"free_{k}"].astype(np.int32)
+        orig = int(data[f"ss_{k}"])
+        total = int(supply.sum())
+
+        # active-row compaction (as the device path does)
+        act = np.nonzero(supply > 0)[0]
+        if len(act) > active_cap:
+            act = np.arange(G)
+        wA = w[act]
+        supA = supply[act]
+        groundA = ground[act]
+        Ga = len(act)
+        col_cap = np.zeros(Mp, np.int64)
+        col_cap[:M] = machine_free
+        col_cap[-1] = total
+        wP = np.zeros((Ga, Mp), np.int64)
+        wP[:, :M] = wA
+        wS = jnp.asarray((wP * n_scale).astype(np.int32))
+        supJ = jnp.asarray(supA)
+        capJ = jnp.asarray(col_cap.astype(np.int32))
+        eps_full = int(max(1, np.abs(wP).max() * n_scale))
+
+        D = np.maximum(groundA[:, None] - wA, 0)
+        w1 = np.where(D > 0, -D, 1)
+        w1P = np.zeros((Ga, Mp), np.int64)
+        w1P[:, :M] = w1
+        wS1 = jnp.asarray((w1P * n_scale).astype(np.int32))
+        two_stage_ok = (total <= int(machine_free.sum())) and bool(
+            ((groundA < 0) | (supA == 0)).all()
+        )
+
+        print(
+            f"inst {k}: rows={Ga} total={total} "
+            f"free={int(machine_free.sum())} two_stage_ok={two_stage_ok} "
+            f"orig_ss={orig}"
+        )
+        obj_ref = None
+        for strat in strategies:
+            ss_total = 0
+            if strat.startswith("two"):
+                # two-stage: stage-1 eps0/budget from the strategy name
+                # two:<eps0>:<budget>  (eps0 'n4' = n_scale/4, '1' = 1)
+                _, e0name, budget = strat.split(":")
+                e0 = {"1": 1, "n4": n_scale // 4, "n": n_scale}[e0name]
+                y1, _pm, s1, conv1 = transport_fori(
+                    wS1, supJ, capJ, 1 << 17, alpha=2, refine_waves=8,
+                    eps0=int(e0), eps0_budget=int(budget),
+                )
+                ss_total += int(s1)
+                if bool(conv1):
+                    y1r = np.asarray(y1, np.int64)[:, :M]
+                    left = supA - y1r.sum(axis=1)
+                    rem = machine_free - y1r.sum(axis=0)
+                    excl = np.cumsum(rem) - rem
+                    grants_m = np.clip(left.sum() - excl, 0, rem)
+                    y2 = split_grants_by_class(grants_m, left)
+                    y_real = y1r + y2
+                else:
+                    y_f, _pm, s2, conv2 = transport_fori(
+                        wS, supJ, capJ, 1 << 17, alpha=2, refine_waves=8,
+                        eps0=int(choose_eps0(n_scale, eps_full, total,
+                                             int(machine_free.sum()))),
+                    )
+                    ss_total += int(s2)
+                    assert bool(conv2)
+                    y_real = np.asarray(y_f, np.int64)[:, :M]
+            else:
+                # direct full solve: full:<eps0name>:<alpha>
+                _, e0name, alpha = strat.split(":")
+                e0 = {"1": 1, "n4": n_scale // 4, "n": n_scale,
+                      "full": eps_full}[e0name]
+                y_f, _pm, s2, conv2 = transport_fori(
+                    wS, supJ, capJ, 1 << 17, alpha=int(alpha),
+                    refine_waves=8, eps0=int(e0),
+                )
+                ss_total += int(s2)
+                assert bool(conv2)
+                y_real = np.asarray(y_f, np.int64)[:, :M]
+            obj = int((wA * y_real).sum())
+            if obj_ref is None:
+                obj_ref = obj
+            flag = "" if obj == obj_ref else f"  OBJ DRIFT ({obj - obj_ref:+d})"
+            print(f"  {strat:14s}: ss={ss_total}{flag}")
 
 
 def instance_from_state(dev, st):
@@ -261,7 +460,9 @@ def main():
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
     cap = sub.add_parser("capture")
-    cap.add_argument("--config", default="whare", choices=["whare", "coco"])
+    cap.add_argument(
+        "--config", default="whare", choices=["whare", "coco", "quincy"]
+    )
     cap.add_argument("--rounds", type=int, default=200)
     cap.add_argument("--warmup", type=int, default=0)
     cap.add_argument("--threshold", type=int, default=5000)
@@ -273,6 +474,14 @@ def main():
     rep.add_argument("--alpha", default="2,8")
     rep.add_argument("--refine", default="8,32")
     rep.set_defaults(fn=replay)
+    repg = sub.add_parser("replay-grouped")
+    repg.add_argument("--inst", default="/tmp/tails_q.npz")
+    repg.add_argument(
+        "--strategies",
+        default="two:1:256,two:n4:1024,full:n4:2,full:n:2",
+        help="comma list: two:<eps0>:<budget> or full:<eps0>:<alpha>",
+    )
+    repg.set_defaults(fn=replay_grouped)
     args = ap.parse_args()
     args.fn(args)
 
