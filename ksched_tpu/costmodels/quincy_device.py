@@ -53,8 +53,8 @@ from .quincy import (
 from ..scheduler.device_bulk import PREF_NONE  # noqa: F401
 
 
-def _transfer_cost(total: int, local: int) -> int:
-    return (COST_PER_MB * max(0, total - local)) // MB
+def _transfer_cost(total: int, local: int, unit_mb: int = 1) -> int:
+    return (COST_PER_MB * max(0, total - local)) // (MB * unit_mb)
 
 
 class QuincyGroupTable:
@@ -71,7 +71,15 @@ class QuincyGroupTable:
         num_machines: int,
         num_classes: int = 1,
         wait_cost_per_round: int = WAIT_COST_PER_ROUND,
+        cost_unit_mb: int = 1,
     ) -> None:
+        """cost_unit_mb quantizes transfer costs to that many megabytes
+        per cost unit (default 1 = the QuincyCostModel scale). Large
+        heterogeneous inputs (multi-GB reads) want coarser units: cost
+        GAPS measured in units bound the price-war descent depth of the
+        solve (a war burns ~gap/eps supersteps), and MB precision on
+        GB-scale transfers buys no placement quality. Quantization also
+        merges near-identical signatures — deliberate compression."""
         if num_groups < 2 * num_classes:
             raise ValueError(
                 f"need a fallback and an overflow group per class: "
@@ -81,6 +89,7 @@ class QuincyGroupTable:
         self.M = int(num_machines)
         self.C = int(num_classes)
         self.wait_cost_per_round = int(wait_cost_per_round)
+        self.cost_unit_mb = int(cost_unit_mb)
         self.blocks = BlockRegistry()
         # Groups 0..C-1 are the classes' no-input fallback groups;
         # C..2C-1 are the per-class OVERFLOW groups (signatures that
@@ -98,8 +107,16 @@ class QuincyGroupTable:
         self._sig2gid: Dict[tuple, int] = {
             (c, 0, ()): c for c in range(self.C)
         }
+        self._gid2sig: Dict[int, tuple] = {}
+        #: signatures currently memoized to each class's overflow gid
+        self._overflow_sigs: Dict[int, set] = {}
         self._next = 2 * self.C
+        self._free: List[int] = []  # evicted gids, reusable
+        #: monotonic use clock + last-use stamp per gid (LRU eviction)
+        self._clock = 0
+        self._last_use: Dict[int, int] = {}
         self.overflowed = 0  # DISTINCT signatures dropped to the overflow group
+        self.evicted = 0  # groups reclaimed by evict_idle
 
     # -- registration ------------------------------------------------------
 
@@ -120,20 +137,27 @@ class QuincyGroupTable:
             total += size
             for m in self.blocks.holders(b):
                 local[m] = local.get(m, 0) + size
-        worst = _transfer_cost(total, 0)
+        worst = _transfer_cost(total, 0, self.cost_unit_mb)
         threshold = PREFERENCE_FRACTION * total
         prefs: List[Tuple[int, int]] = sorted(
-            (m, _transfer_cost(total, b))
+            (m, _transfer_cost(total, b, self.cost_unit_mb))
             for m, b in local.items()
             if b > threshold and 0 <= m < self.M
         )
         sig = (int(task_class), worst, tuple(prefs))
+        self._clock += 1
         gid = self._sig2gid.get(sig)
         if gid is not None:
+            self._last_use[gid] = self._clock
             return gid
         if not prefs and worst == 0:
             return int(task_class)  # the fallback group IS this signature
-        if self._next >= self.G:
+        if self._free:
+            gid = self._free.pop()
+        elif self._next < self.G:
+            gid = self._next
+            self._next += 1
+        else:
             # table full: land in the class's overflow group, repriced
             # upward to cover the costliest overflowed signature. The
             # signature is memoized to the overflow gid so repeated
@@ -142,12 +166,13 @@ class QuincyGroupTable:
             self.overflowed += 1
             gid = self.C + int(task_class)
             self._sig2gid[sig] = gid
+            self._overflow_sigs.setdefault(gid, set()).add(sig)
             self.e[gid] = max(self.e[gid], worst)
             self.u[gid] = self.e[gid] + 1
             return gid
-        gid = self._next
-        self._next += 1
         self._sig2gid[sig] = gid
+        self._gid2sig[gid] = sig
+        self._last_use[gid] = self._clock
         self.cls[gid] = int(task_class)
         self.job[gid] = int(job)
         # Route base: worst-case transfer (nothing local) — the task ->
@@ -156,6 +181,7 @@ class QuincyGroupTable:
         # QuincyCostModel.task_to_unscheduled_agg_cost.
         self.e[gid] = worst
         self.u[gid] = worst + 1
+        self.pref_w[gid, :] = PREF_NONE
         for m, cost in prefs:
             self.pref_w[gid, m] = cost
         return gid
@@ -177,6 +203,61 @@ class QuincyGroupTable:
         return out
 
     # -- lifecycle ---------------------------------------------------------
+
+    def evict_idle(
+        self, live_per_group: np.ndarray, keep_fraction: float = 0.5
+    ) -> int:
+        """LRU signature eviction: reclaim registered groups with ZERO
+        live tasks, least-recently-used first, until at most
+        `keep_fraction` of the dynamic gid range stays occupied (or no
+        idle group remains). A long-running cluster's signature table
+        would otherwise fill permanently — every evicted gid returns to
+        a free pool that group_for reuses BEFORE overflowing, so the
+        table tracks the working set instead of history. Reserved
+        fallback/overflow gids (< 2C) are never evicted; a group with
+        live tasks is never evicted (its row still prices them).
+
+        Call with per-group live counts (from the host mirror of
+        admissions/completions, or a fetched state's grp/live arrays)
+        at table-maintenance cadence — e.g. between timed chunks;
+        follow with sync() to push the cleared rows. Returns the number
+        of groups reclaimed."""
+        dyn = max(1, self.G - 2 * self.C)
+        occupied = len(self._gid2sig)
+        target = int(dyn * keep_fraction)
+        if occupied <= target:
+            return 0
+        live = np.asarray(live_per_group)
+        idle = [
+            gid for gid in self._gid2sig if live[gid] == 0
+        ]
+        idle.sort(key=lambda g: self._last_use.get(g, 0))
+        n_evict = min(len(idle), occupied - target)
+        for gid in idle[:n_evict]:
+            sig = self._gid2sig.pop(gid)
+            self._sig2gid.pop(sig, None)
+            self._last_use.pop(gid, None)
+            self._free.append(gid)
+            self.e[gid] = 0
+            self.u[gid] = 1
+            self.pref_w[gid, :] = PREF_NONE
+            self.wait_rounds[gid] = 0
+        self.evicted += n_evict
+        # Un-pin overflow memoizations too: once eviction frees room, a
+        # signature that first appeared under table pressure must be
+        # able to register PROPERLY on next sight — otherwise hot
+        # overflowed signatures stay preference-less forever and the
+        # table tracks history, not the working set. When an overflow
+        # row is also idle, its ratcheted conservative price resets.
+        if n_evict:
+            for og, sigs in self._overflow_sigs.items():
+                for sig in sigs:
+                    self._sig2gid.pop(sig, None)
+                sigs.clear()
+                if live[og] == 0:
+                    self.e[og] = 0
+                    self.u[og] = 1
+        return n_evict
 
     def drop_machine(self, machine_index: int) -> None:
         """Machine loss: its replicas disappear; existing groups keep

@@ -483,6 +483,11 @@ class DeviceTraceReplayDriver:
             # trace ended with deferred same-window finishes: one extra
             # completion-only window retires them
             flush_window([], [])
+        if not windows:
+            raise ValueError(
+                "trace yielded no schedulable windows (no task events, "
+                "or only finishes for unknown tasks)"
+            )
 
         K = len(windows)
         Amax = max(1, max(len(w["adm"]) for w in windows))

@@ -260,6 +260,8 @@ class DeviceBulkCluster:
         self._groups_cls_host = (
             np.zeros(self.G, np.int32) if self.grouped else None
         )
+        #: steady-round arrival group draw map (see set_arrival_groups)
+        self._arrival_map = jnp.arange(max(self.G, 1), dtype=jnp.int32)
         self._build_programs()
         self.last_stats: Optional[dict] = None
         self.last_admitted = None  # device i32 from the latest add_tasks
@@ -598,12 +600,22 @@ class DeviceBulkCluster:
                     eps_full_x = jnp.maximum(jnp.max(jnp.abs(wS_x)), i32(1))
 
                     def solve_full(_):
+                        # eps0 = n_scale for grouped instances (not the
+                        # global n_scale/4 default): the round-3 tail
+                        # study's grouped replay shows blocked quincy
+                        # rounds at 1.0-3.3k supersteps from the
+                        # full-unit start vs 7.2-13.3k from n/4 and
+                        # ~134k from eps0=1 — the sparse strong
+                        # discounts over uniform ground want full-unit
+                        # price-war steps (tools/tail_repro.py
+                        # replay-grouped).
                         y_f, _pmf, s_f, c_f = transport_fori(
                             wS_x, supply_x, col_cap, supersteps,
                             alpha=2, refine_waves=8,
                             eps0=choose_eps0(
                                 n_scale, eps_full_x, total_x,
                                 jnp.sum(machine_free),
+                                short=n_scale,
                             ),
                         )
                         return y_f, s_f, c_f
@@ -615,26 +627,43 @@ class DeviceBulkCluster:
                         # eps0=1 finishes the sparse matching in tens
                         # of waves when pref capacity suffices, but
                         # stalls on deep descents when residents block
-                        # the preferred machines — bound it and fall
-                        # back to the refined full range
+                        # the preferred machines — bound it HONESTLY
+                        # (eps0_retry=False: no internal full-range
+                        # retry on the discount matrix, which the tail
+                        # study measured at 3.2-11.7k supersteps on
+                        # blocked rounds) and fall back to the refined
+                        # full solve of the ORIGINAL matrix (~1-3.3k).
                         y1, _pm1, s1, conv1 = transport_fori(
                             wS1_x, supply_x, col_cap, supersteps,
                             alpha=2, refine_waves=8,
                             eps0=i32(1), eps0_budget=256,
+                            eps0_retry=False,
                         )
-                        y1r = y1[:, :M]
-                        left = supply_x - jnp.sum(y1r, axis=1).astype(i32)
-                        rem = machine_free - jnp.sum(y1r, axis=0).astype(i32)
-                        excl = jnp.cumsum(rem) - rem
-                        grants_m = jnp.clip(jnp.sum(left) - excl, 0, rem)
-                        y2 = split_grants_by_class(grants_m, left)
-                        y_out = y1.at[:, :M].add(y2.astype(i32))
-                        # escape column: anything beyond real capacity
-                        y_out = y_out.at[:, Mp - 1].set(
-                            supply_x
-                            - jnp.sum(y_out[:, :M], axis=1).astype(i32)
+
+                        def finish_two_stage(_):
+                            y1r = y1[:, :M]
+                            left = supply_x - jnp.sum(y1r, axis=1).astype(i32)
+                            rem = machine_free - jnp.sum(y1r, axis=0).astype(
+                                i32
+                            )
+                            excl = jnp.cumsum(rem) - rem
+                            grants_m = jnp.clip(jnp.sum(left) - excl, 0, rem)
+                            y2 = split_grants_by_class(grants_m, left)
+                            y_out = y1.at[:, :M].add(y2.astype(i32))
+                            # escape column: anything beyond real capacity
+                            y_out = y_out.at[:, Mp - 1].set(
+                                supply_x
+                                - jnp.sum(y_out[:, :M], axis=1).astype(i32)
+                            )
+                            return y_out, s1, conv1
+
+                        def fall_back(_):
+                            y_f, s_f, c_f = solve_full(None)
+                            return y_f, s1 + s_f, c_f
+
+                        return lax.cond(
+                            conv1, finish_two_stage, fall_back, operand=None
                         )
-                        return y_out, s1, conv1
 
                     two_stage_ok = (
                         (total_x <= jnp.sum(machine_free))
@@ -788,8 +817,13 @@ class DeviceBulkCluster:
                 jnp.zeros(Mp, i32).at[:M].set(col_cap_m).at[Mp - 1].set(total)
             )
             eps_full = jnp.maximum(jnp.max(jnp.abs(wS_hi)), i32(1))
+            # full-unit start for the tiered re-solve (short=n_scale):
+            # the round-3 tiered replay sweep measured it 2-6x under
+            # the global n/4 default on captured preemption rounds
+            # (22.6k -> 8.5k supersteps worst), refinement on
             eps0 = choose_eps0(
-                n_scale, eps_full, total, jnp.sum(col_cap_m)
+                n_scale, eps_full, total, jnp.sum(col_cap_m),
+                short=n_scale,
             )
             if discount == 0 and row_constant:
                 # tiers coincide AND rows are machine-uniform: the
@@ -808,7 +842,7 @@ class DeviceBulkCluster:
             else:
                 y, _pm, solve_steps, converged = transport_fori_tiered(
                     wS_lo, wS_hi, R_pad, supply, col_cap, supersteps,
-                    alpha=alpha, eps0=eps0,
+                    alpha=alpha, eps0=eps0, refine_waves=refine_waves,
                 )
             y_real = y[:, :M]
 
@@ -924,14 +958,16 @@ class DeviceBulkCluster:
             )
 
         def steady_round(state: DeviceClusterState, gspec, key, churn_prob,
-                         arrivals):
+                         arrivals, arrival_map):
             """One benchmark round: complete ~churn_prob of running
             tasks, admit `arrivals` new ones (random job/class — or a
-            random GROUP in group mode, with class/job gathered from
-            the group metadata), then schedule. Entirely on device so
-            rounds chain without host sync — the incremental re-solve
-            regime Flowlessly's daemon mode serves in the reference
-            (placement/solver.go:60-90)."""
+            random GROUP in group mode, drawn through `arrival_map`
+            [Gn] so the host can restrict arrivals to REGISTERED
+            signatures when the table churns under LRU eviction; class
+            and job gathered from the group metadata), then schedule.
+            Entirely on device so rounds chain without host sync — the
+            incremental re-solve regime Flowlessly's daemon mode serves
+            in the reference (placement/solver.go:60-90)."""
             k1, k2, k3, k4 = jax.random.split(key, 4)
             placed = state.live & (state.pu >= 0)
             done = placed & (
@@ -947,7 +983,7 @@ class DeviceBulkCluster:
             free_rank = jnp.cumsum(~state.live) - 1
             newmask = ~state.live & (free_rank < arrivals)
             if grouped:
-                new_grp = jax.random.randint(k2, (Tcap,), 0, Gn)
+                new_grp = arrival_map[jax.random.randint(k2, (Tcap,), 0, Gn)]
                 new_cls = gspec.cls[new_grp]
                 new_job = gspec.job[new_grp]
             else:
@@ -1074,11 +1110,13 @@ class DeviceBulkCluster:
         self._complete_jit = jax.jit(complete)
         self._set_machine_jit = jax.jit(set_machine, static_argnums=(2,))
 
-        def steady_scan(state, gspec, key0, churn_prob, arrivals, num_rounds):
+        def steady_scan(state, gspec, key0, churn_prob, arrivals, num_rounds,
+                        arrival_map):
             keys = jax.random.split(key0, num_rounds)
 
             def body(s, k):
-                return steady_round(s, gspec, k, churn_prob, arrivals)
+                return steady_round(s, gspec, k, churn_prob, arrivals,
+                                    arrival_map)
 
             return lax.scan(body, state, keys)
 
@@ -1217,7 +1255,9 @@ class DeviceBulkCluster:
         self, num_rounds: int, churn_prob: float, arrivals: int, seed: int = 0
     ):
         """`num_rounds` chained churn rounds fully on device. Returns
-        stacked stats (device arrays, un-fetched)."""
+        stacked stats (device arrays, un-fetched). In group mode,
+        arrivals draw their group through the arrival map (identity by
+        default; see set_arrival_groups)."""
         self.state, stats = self._steady_scan_jit(
             self.state,
             self.groups,
@@ -1225,9 +1265,23 @@ class DeviceBulkCluster:
             jnp.float32(churn_prob),
             int(arrivals),
             int(num_rounds),
+            self._arrival_map,
         )
         self.last_stats = stats
         return stats
+
+    def set_arrival_groups(self, gids) -> None:
+        """Restrict on-device steady-round arrivals to these group ids
+        (tiled/truncated to [G]): with LRU signature eviction the table
+        has FREED rows between maintenance points, and uniform draws
+        over [0, G) would admit tasks into them — zero-signature rows
+        the real policy never populates. Host -> device upload only."""
+        if not self.grouped:
+            raise ValueError("set_arrival_groups requires group mode")
+        g = np.asarray(gids, np.int32)
+        if g.size == 0 or ((g < 0) | (g >= self.G)).any():
+            raise ValueError("gids must be non-empty, within [0, G)")
+        self._arrival_map = jnp.asarray(np.resize(g, self.G))
 
     def run_replay_rounds(self, schedule, seed: int = 0):
         """Replay `schedule` (a staged window schedule — see

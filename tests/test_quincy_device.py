@@ -62,6 +62,67 @@ def test_group_table_overflow_goes_to_priced_overflow_group():
     assert (t.pref_w[1] == t.pref_w[0]).all()  # no preferences
 
 
+def test_group_table_lru_eviction_reclaims_and_reuses():
+    """evict_idle reclaims zero-live groups LRU-first; freed gids are
+    reused BEFORE overflowing, and an overflowed signature can register
+    properly after eviction frees room."""
+    t = QuincyGroupTable(num_groups=4, num_machines=4)
+    # 1 class -> gids 0 (fallback), 1 (overflow), 2..3 dynamic
+    t.blocks.register(1, 512 * MB, [0])
+    t.blocks.register(2, 256 * MB, [1])
+    t.blocks.register(3, 128 * MB, [2])
+    g1 = t.group_for(0, [1])
+    g2 = t.group_for(0, [2])
+    assert {g1, g2} == {2, 3}
+    g3 = t.group_for(0, [3])  # full -> overflow
+    assert g3 == 1 and t.overflowed == 1
+
+    # g1 has live tasks, g2 idle; touch g1 so g2 is also the LRU
+    t.group_for(0, [1])
+    live = np.zeros(4, np.int64)
+    live[g1] = 5
+    n = t.evict_idle(live, keep_fraction=0.0)
+    assert n == 1 and t.evicted == 1
+    assert (t.pref_w[g2] == PREF_NONE).all()
+    # signature 3 was only memoized to the overflow gid; a NEW distinct
+    # signature reuses the freed slot instead of overflowing
+    t.blocks.register(4, 64 * MB, [3])
+    g4 = t.group_for(0, [4])
+    assert g4 == g2  # reused the evicted gid
+    assert t.pref_w[g4, 3] == 0 and t.e[g4] == 64
+    # signature 2 re-registers fresh after its eviction (not stale-mapped)
+    live2 = np.zeros(4, np.int64)
+    live2[g1] = 5
+    live2[g4] = 1
+    assert t.evict_idle(live2, keep_fraction=1.0) == 0  # under target
+    g2b = t.group_for(0, [2])
+    assert g2b == 1  # table full again -> overflow (g2's slot is taken)
+
+
+def test_group_table_overflow_unpins_after_eviction():
+    """A signature that first appeared under table pressure (memoized
+    to the overflow gid) must register PROPERLY once eviction frees
+    room — overflow pinning is pressure-scoped, not permanent."""
+    t = QuincyGroupTable(num_groups=4, num_machines=4)
+    t.blocks.register(1, 512 * MB, [0])
+    t.blocks.register(2, 256 * MB, [1])
+    t.blocks.register(3, 128 * MB, [2])
+    g1 = t.group_for(0, [1])
+    g2 = t.group_for(0, [2])
+    g3 = t.group_for(0, [3])  # table full -> overflow, sig pinned
+    assert g3 == 1
+    # overflow price ratcheted to the overflowed signature's worst
+    assert t.e[1] == 128
+    live = np.zeros(4, np.int64)
+    live[g1] = 2  # g2 idle -> evictable; overflow row idle too
+    assert t.evict_idle(live, keep_fraction=0.0) == 1
+    # idle overflow row's conservative ratchet reset
+    assert t.e[1] == 0 and t.u[1] == 1
+    g3b = t.group_for(0, [3])
+    assert g3b == g2  # re-registered properly in the freed slot
+    assert t.pref_w[g3b, 2] == 0 and t.e[g3b] == 128
+
+
 def test_group_table_drop_machine_prunes_prefs():
     t = QuincyGroupTable(num_groups=8, num_machines=4)
     t.blocks.register(1, 512 * MB, [2])

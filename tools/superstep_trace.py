@@ -165,6 +165,8 @@ if __name__ == "__main__":
     ap.add_argument("--every", type=int, default=500)
     args = ap.parse_args()
 
+    from ksched_tpu.solver.layered import default_eps0
+
     data = np.load(args.inst)
     Mp = int(data["Mp"])
     w = data[f"w_{args.k}"].astype(np.int64)
@@ -174,7 +176,7 @@ if __name__ == "__main__":
     wP = np.zeros((C, Mp), np.int64)
     wP[:, :M] = w
     wS = wP * args.n_scale
-    eps0 = args.eps0 if args.eps0 is not None else max(1, args.n_scale // 16)
+    eps0 = args.eps0 if args.eps0 is not None else default_eps0(args.n_scale)
     sched = []
     e = eps0
     while True:

@@ -66,6 +66,20 @@ def build_config(name: str):
             unsched_cost=_coco_unsched(), ec_cost=0,
             supersteps=1 << 17, decode_width=4096,
         )
+    elif name == "coco-preempt":
+        # scaled-down preemption-on CoCo (CPU-capturable): same
+        # structure as coco50k-preempt at 20k tasks
+        tasks, machines = 20_000, 1_000
+        penalties = rng.integers(0, 40, (machines, 4)).astype(np.int64)
+        dev = DeviceBulkCluster(
+            num_machines=machines, pus_per_machine=4, slots_per_pu=8,
+            num_jobs=20, num_task_classes=4,
+            task_capacity=next_pow2(tasks + 4096),
+            class_cost_fn=coco_device_cost_fn(penalties),
+            unsched_cost=_coco_unsched(), ec_cost=0,
+            supersteps=1 << 17,
+            preemption=True, continuation_discount=8,
+        )
     elif name == "quincy":
         from ksched_tpu.costmodels.quincy_device import QuincyGroupTable
 
@@ -217,14 +231,18 @@ def capture(args) -> None:
     # the same magnitude) and fully reproducible.
     out = {}
     for k, (ss, st) in enumerate(insts):
-        w, supply, col_cap = instance_from_state(dev, st)
-        out[f"w_{k}"] = w
-        out[f"supply_{k}"] = supply
-        out[f"colcap_{k}"] = col_cap
+        inst = instance_from_state(dev, st)
+        out[f"w_{k}"] = inst[0]
+        out[f"supply_{k}"] = inst[1]
+        out[f"colcap_{k}"] = inst[2]
+        if dev.preemption:
+            out[f"residents_{k}"] = inst[3]
         out[f"ss_{k}"] = np.int64(ss)
     out["n"] = np.int64(len(insts))
     out["n_scale"] = np.int64(dev.n_scale)
     out["Mp"] = np.int64(dev.Mp)
+    out["preempt"] = np.int64(int(dev.preemption))
+    out["discount"] = np.int64(dev.continuation_discount)
     np.savez_compressed(args.out, **out)
     print(f"wrote {len(insts)} instances to {args.out}")
 
@@ -333,6 +351,7 @@ def replay_grouped(args) -> None:
                 y1, _pm, s1, conv1 = transport_fori(
                     wS1, supJ, capJ, 1 << 17, alpha=2, refine_waves=8,
                     eps0=int(e0), eps0_budget=int(budget),
+                    eps0_retry=False,  # the production honest bound
                 )
                 ss_total += int(s1)
                 if bool(conv1):
@@ -347,7 +366,8 @@ def replay_grouped(args) -> None:
                     y_f, _pm, s2, conv2 = transport_fori(
                         wS, supJ, capJ, 1 << 17, alpha=2, refine_waves=8,
                         eps0=int(choose_eps0(n_scale, eps_full, total,
-                                             int(machine_free.sum()))),
+                                             int(machine_free.sum()),
+                                             short=n_scale)),
                     )
                     ss_total += int(s2)
                     assert bool(conv2)
@@ -374,7 +394,9 @@ def replay_grouped(args) -> None:
 def instance_from_state(dev, st):
     """Rebuild (w[C,M], supply[C], col_cap[Mp]) the round core would
     solve from a fetched DeviceClusterState — mirrors round_core
-    (scheduler/device_bulk.py) with a zero window offset."""
+    (scheduler/device_bulk.py) with a zero window offset. In preempt
+    mode (round_core_preempt): supply = ALL live tasks, col_cap = total
+    slots, and a 4th return carries the resident census R[C, M]."""
     import jax.numpy as jnp
 
     live = np.asarray(st["live"])
@@ -397,12 +419,20 @@ def instance_from_state(dev, st):
     cost_cm = np.asarray(dev.class_cost_fn(jnp.asarray(census))).astype(np.int64)
     w = cost_cm + dev.ec_cost - dev.unsched_cost
 
+    col_cap = np.zeros(dev.Mp, np.int64)
+    if dev.preemption:
+        supply = np.bincount(cls[live], minlength=C)
+        col_cap[:M] = np.where(enabled, P * S, 0)
+        col_cap[-1] = supply.sum()
+        R = np.zeros((C, M), np.int64)
+        np.add.at(R, (cls[placed], machine[placed]), 1)
+        return (w.astype(np.int32), supply.astype(np.int32),
+                col_cap.astype(np.int32), R.astype(np.int32))
+
     unplaced = live & (pu < 0)
     W = dev.decode_width or dev.Tcap
     rows = np.nonzero(unplaced)[0][:W]
     supply = np.bincount(cls[rows], minlength=C)
-
-    col_cap = np.zeros(dev.Mp, np.int64)
     col_cap[:M] = machine_free
     col_cap[-1] = supply.sum()
     return w.astype(np.int32), supply.astype(np.int32), col_cap.astype(np.int32)
@@ -456,12 +486,68 @@ def replay(args) -> None:
                 )
 
 
+def replay_tiered(args) -> None:
+    """Re-solve captured PREEMPT (tiered) instances under eps0/refine
+    sweeps — transport_fori_tiered outside the jitted round."""
+    import jax.numpy as jnp
+
+    from ksched_tpu.solver.layered import transport_fori_tiered
+
+    data = np.load(args.inst)
+    assert int(data["preempt"]) == 1, "not a preempt capture"
+    n = int(data["n"])
+    n_scale = int(data["n_scale"])
+    Mp = int(data["Mp"])
+    discount = int(data["discount"])
+    refines = [int(r) for r in args.refine.split(",")]
+
+    for k in range(n):
+        w = data[f"w_{k}"].astype(np.int64)
+        supply = data[f"supply_{k}"]
+        col_cap = data[f"colcap_{k}"]
+        R = data[f"residents_{k}"].astype(np.int64)
+        orig = int(data[f"ss_{k}"])
+        C, M = w.shape
+        wHiP = np.zeros((C, Mp), np.int64)
+        wHiP[:, :M] = w
+        wLoP = wHiP.copy()
+        wLoP[:, :M] -= discount
+        RP = np.zeros((C, Mp), np.int64)
+        RP[:, :M] = R
+        wHi = jnp.asarray((wHiP * n_scale).astype(np.int32))
+        wLo = jnp.asarray((wLoP * n_scale).astype(np.int32))
+        RJ = jnp.asarray(RP.astype(np.int32))
+        supJ = jnp.asarray(supply)
+        capJ = jnp.asarray(col_cap)
+        eps_full = int(max(1, np.abs(wHiP).max() * n_scale))
+        print(f"inst {k}: C={C} total={int(supply.sum())} "
+              f"residents={int(R.sum())} cap={int(col_cap[:M].sum())} "
+              f"orig_ss={orig}")
+        obj_ref = None
+        for label, eps0 in [("full", eps_full), ("n", n_scale),
+                            ("n/4", n_scale // 4), ("n/16", n_scale // 16)]:
+            for rw in refines:
+                y, _pm, steps, conv = transport_fori_tiered(
+                    wLo, wHi, RJ, supJ, capJ, 1 << 17,
+                    alpha=8, eps0=int(max(1, eps0)), refine_waves=rw,
+                )
+                yr = np.asarray(y, np.int64)[:, :M]
+                ret = np.minimum(yr, R)
+                obj = int((wHiP[:, :M] * yr).sum() - discount * ret.sum())
+                if obj_ref is None:
+                    obj_ref = obj
+                drift = "" if obj == obj_ref else f"  OBJ {obj - obj_ref:+d}"
+                print(f"  eps0={label:5s} refine={rw:2d}: ss={int(steps)} "
+                      f"conv={bool(conv)}{drift}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
     cap = sub.add_parser("capture")
     cap.add_argument(
-        "--config", default="whare", choices=["whare", "coco", "quincy"]
+        "--config", default="whare",
+        choices=["whare", "coco", "quincy", "coco-preempt"],
     )
     cap.add_argument("--rounds", type=int, default=200)
     cap.add_argument("--warmup", type=int, default=0)
@@ -482,6 +568,10 @@ def main():
         help="comma list: two:<eps0>:<budget> or full:<eps0>:<alpha>",
     )
     repg.set_defaults(fn=replay_grouped)
+    rept = sub.add_parser("replay-tiered")
+    rept.add_argument("--inst", default="/tmp/tails_preempt.npz")
+    rept.add_argument("--refine", default="0,8")
+    rept.set_defaults(fn=replay_tiered)
     args = ap.parse_args()
     args.fn(args)
 
