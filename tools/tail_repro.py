@@ -90,7 +90,9 @@ def build_config(name: str):
             num_jobs=10, task_capacity=next_pow2(tasks + 4096),
             num_groups=G, supersteps=1 << 17, decode_width=2048,
         )
-        table = QuincyGroupTable(num_groups=G, num_machines=machines)
+        table = QuincyGroupTable(
+            num_groups=G, num_machines=machines, cost_unit_mb=64
+        )
         for b in range(1, n_blocks + 1):
             table.blocks.register(
                 b, 512 * MBv,
