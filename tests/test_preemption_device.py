@@ -324,9 +324,11 @@ def test_device_preemption_preempt_parity_with_graph_path():
     assert int(s2["unscheduled"]) == 2
 
 
-def test_device_preemption_rejects_decode_window():
-    with pytest.raises(ValueError):
-        DeviceBulkCluster(
-            num_machines=2, pus_per_machine=1, slots_per_pu=1, num_jobs=1,
-            task_capacity=16, preemption=True, decode_width=4,
-        )
+def test_device_preemption_accepts_mover_decode_window():
+    """decode_width in preemption mode bounds the MOVER decode (round-3
+    feature; behavioral coverage in test_bounded_decode.py)."""
+    dev = DeviceBulkCluster(
+        num_machines=2, pus_per_machine=1, slots_per_pu=1, num_jobs=1,
+        task_capacity=16, preemption=True, decode_width=4,
+    )
+    assert dev.decode_width == 4 and dev.preemption
