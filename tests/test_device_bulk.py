@@ -235,6 +235,27 @@ def test_per_job_unsched_host_bulk_layered_matches_csr():
     assert outs[0] == outs[1] == ([1, 1], 2)
 
 
+def test_arrival_group_map_restricts_steady_draws():
+    """set_arrival_groups must confine on-device steady-round arrival
+    groups to the given set (the LRU-churn invariant: freed rows are
+    not valid commodities)."""
+    dev = DeviceBulkCluster(
+        num_machines=4, pus_per_machine=1, slots_per_pu=4, num_jobs=2,
+        task_capacity=128, num_groups=8, supersteps=1 << 12,
+    )
+    dev.set_arrival_groups([2, 5])
+    dev.add_tasks(4, np.zeros(4, np.int32), groups=np.full(4, 2, np.int32))
+    stats = dev.fetch_stats(
+        dev.run_steady_rounds(6, churn_prob=0.2, arrivals=4, seed=3)
+    )
+    assert stats["converged"].all()
+    assert int(stats["admitted"].sum()) > 0  # the map was exercised
+    st = {k: np.asarray(v) for k, v in dev.fetch_state().items()}
+    assert set(st["grp"][st["live"]].tolist()) <= {2, 5}
+    with pytest.raises(ValueError):
+        dev.set_arrival_groups([99])
+
+
 def test_per_job_unsched_equal_costs_stays_degenerate():
     """Equal per-job costs must collapse to the closed form (no
     iterations) — the group expansion alone must not force the
