@@ -596,15 +596,18 @@ def _quincy_multiblock_bench(
     n_templates = 640  # > dynamic table room: guarantees pressure
     rng = np.random.default_rng(7)
 
-    # 128 MB cost units: MB-granularity costs on multi-GB reads span
-    # ~12k distinct values, and price-war descent depth scales with the
-    # cost GAPS in units — measured unsolvable-in-budget at unit=1 on
-    # JAX-CPU. Coarser units bound war depth AND merge near-identical
-    # signatures: at 128 MB the distinct-signature count drops 537 ->
-    # 484, overflow 86 -> 25, and the realized-cost gap vs the
-    # same-quantum exact oracle falls 17.8% -> 3.1% mean (6.2% max).
+    # Split quanta: MB-granularity costs on multi-GB reads span ~12k
+    # values and price-war depth scales with cost gaps in units
+    # (unsolvable-in-budget at unit=1 on JAX-CPU); but cost and
+    # signature quantization pull OPPOSITE ways — coarse costs create
+    # exact cross-group ties that herd the synchronous solve (measured
+    # p99 supersteps 3253 at 64 MB vs 6989 at uniform 128 MB), while a
+    # coarse SIGNATURE key merges near-identical templates (overflow
+    # 86 -> 27, realized gap 17.8% -> 3.6% at 128). cost 64 / sig 128
+    # takes both.
     table = QuincyGroupTable(
-        num_groups=G, num_machines=machines, cost_unit_mb=128
+        num_groups=G, num_machines=machines,
+        cost_unit_mb=64, sig_unit_mb=128,
     )
     # Heavy-tailed block sizes (128 MB .. 4 GB): with uniform sizes a
     # multi-block read has NO preferred machine (no single holder

@@ -72,6 +72,7 @@ class QuincyGroupTable:
         num_classes: int = 1,
         wait_cost_per_round: int = WAIT_COST_PER_ROUND,
         cost_unit_mb: int = 1,
+        sig_unit_mb: Optional[int] = None,
     ) -> None:
         """cost_unit_mb quantizes transfer costs to that many megabytes
         per cost unit (default 1 = the QuincyCostModel scale). Large
@@ -79,7 +80,17 @@ class QuincyGroupTable:
         GAPS measured in units bound the price-war descent depth of the
         solve (a war burns ~gap/eps supersteps), and MB precision on
         GB-scale transfers buys no placement quality. Quantization also
-        merges near-identical signatures — deliberate compression."""
+        merges near-identical signatures — deliberate compression.
+
+        sig_unit_mb (default = cost_unit_mb) quantizes the GROUPING KEY
+        independently of the stored costs: the two pull opposite ways —
+        a coarse signature quantum merges near-identical templates
+        (fewer distinct signatures, less overflow, smaller quality
+        gap), while a fine cost quantum keeps cross-group cost ties
+        rare (exact ties herd the synchronous solve). A merged group
+        carries its first-registered template's costs at cost_unit
+        resolution — representative of the merged set, the same
+        approximation grouping itself makes."""
         if num_groups < 2 * num_classes:
             raise ValueError(
                 f"need a fallback and an overflow group per class: "
@@ -90,6 +101,15 @@ class QuincyGroupTable:
         self.C = int(num_classes)
         self.wait_cost_per_round = int(wait_cost_per_round)
         self.cost_unit_mb = int(cost_unit_mb)
+        self.sig_unit_mb = int(
+            cost_unit_mb if sig_unit_mb is None else sig_unit_mb
+        )
+        if self.sig_unit_mb < self.cost_unit_mb:
+            raise ValueError(
+                f"sig_unit_mb ({self.sig_unit_mb}) must be >= cost_unit_mb "
+                f"({self.cost_unit_mb}): a finer signature quantum would "
+                "split cost-identical templates into distinct groups"
+            )
         self.blocks = BlockRegistry()
         # Groups 0..C-1 are the classes' no-input fallback groups;
         # C..2C-1 are the per-class OVERFLOW groups (signatures that
@@ -104,9 +124,11 @@ class QuincyGroupTable:
         self.u = np.ones(self.G, np.int64)  # worst(0) + 1
         self.pref_w = np.full((self.G, self.M), PREF_NONE, np.int64)
         self.wait_rounds = np.zeros(self.G, np.int64)
-        self._sig2gid: Dict[tuple, int] = {
-            (c, 0, ()): c for c in range(self.C)
-        }
+        # note: the class fallback groups (gid < C) are matched by the
+        # explicit zero-cost check in group_for, not by this dict — a
+        # coarse sig quantum can floor a NONZERO-cost signature to
+        # (c, 0, ()), which must not collide with them
+        self._sig2gid: Dict[tuple, int] = {}
         self._gid2sig: Dict[int, tuple] = {}
         #: signatures currently memoized to each class's overflow gid
         self._overflow_sigs: Dict[int, set] = {}
@@ -139,19 +161,39 @@ class QuincyGroupTable:
                 local[m] = local.get(m, 0) + size
         worst = _transfer_cost(total, 0, self.cost_unit_mb)
         threshold = PREFERENCE_FRACTION * total
-        prefs: List[Tuple[int, int]] = sorted(
-            (m, _transfer_cost(total, b, self.cost_unit_mb))
-            for m, b in local.items()
-            if b > threshold and 0 <= m < self.M
+        # one pass emits both the stored costs (cost_unit) and the
+        # grouping key's quantized values (sig_unit >= cost_unit merges
+        # near-identical templates; stored costs stay fine so
+        # cross-group cost ties stay rare)
+        prefs: List[Tuple[int, int]] = []
+        sig_prefs: List[Tuple[int, int]] = []
+        for m, b in sorted(local.items()):
+            if b > threshold and 0 <= m < self.M:
+                prefs.append((m, _transfer_cost(total, b, self.cost_unit_mb)))
+                sig_prefs.append(
+                    (m, _transfer_cost(total, b, self.sig_unit_mb))
+                )
+        # the TRUE (cost-unit) values decide fallback membership: a
+        # coarse sig quantum must not collapse a nonzero-cost template
+        # onto the zero-cost fallback group
+        if not prefs and worst == 0:
+            return int(task_class)  # the fallback group IS this signature
+        sig = (
+            int(task_class),
+            _transfer_cost(total, 0, self.sig_unit_mb),
+            tuple(sig_prefs),
         )
-        sig = (int(task_class), worst, tuple(prefs))
         self._clock += 1
         gid = self._sig2gid.get(sig)
         if gid is not None:
             self._last_use[gid] = self._clock
+            if self.C <= gid < 2 * self.C:
+                # overflow rows stay conservative across MERGED
+                # templates too: a memoized hit can carry a worst up to
+                # one sig quantum above the first registrant's
+                self.e[gid] = max(self.e[gid], worst)
+                self.u[gid] = self.e[gid] + 1
             return gid
-        if not prefs and worst == 0:
-            return int(task_class)  # the fallback group IS this signature
         if self._free:
             gid = self._free.pop()
         elif self._next < self.G:

@@ -99,6 +99,54 @@ def test_group_table_lru_eviction_reclaims_and_reuses():
     assert g2b == 1  # table full again -> overflow (g2's slot is taken)
 
 
+def test_group_table_split_quanta_semantics():
+    """sig_unit_mb coarser than cost_unit_mb: near-identical templates
+    merge into one group while stored costs keep cost-unit resolution;
+    a nonzero-cost no-preference template must NOT collapse onto the
+    zero-cost fallback group; overflow pricing stays conservative
+    across merged templates; finer sig than cost is rejected."""
+    t = QuincyGroupTable(
+        num_groups=6, num_machines=4, cost_unit_mb=1, sig_unit_mb=128
+    )
+    # two templates whose costs differ by < one sig quantum merge
+    t.blocks.register(1, 512 * MB, [0])
+    t.blocks.register(2, 513 * MB, [0])
+    g1 = t.group_for(0, [1])
+    g2 = t.group_for(0, [2])
+    assert g1 == g2
+    assert t.e[g1] == 512  # first registrant's cost-unit values
+    # a 100 MB orphaned block (no holders above threshold): sig-worst
+    # floors to 0 but the TRUE cost is 100 — must get its own group,
+    # not the free fallback
+    t.blocks.register(3, 100 * MB, [])
+    g3 = t.group_for(0, [3])
+    assert g3 != 0 and t.e[g3] == 100 and t.u[g3] == 101
+    # genuinely-zero template still takes the fallback
+    assert t.group_for(0, []) == 0
+
+    with pytest.raises(ValueError):
+        QuincyGroupTable(
+            num_groups=4, num_machines=2, cost_unit_mb=64, sig_unit_mb=1
+        )
+
+
+def test_group_table_overflow_ratchet_covers_merged_templates():
+    """With split quanta, templates merged into one overflow signature
+    can differ by up to a sig quantum; the overflow price must ratchet
+    on memoized hits too (never undercharge)."""
+    t = QuincyGroupTable(
+        num_groups=2, num_machines=4, cost_unit_mb=1, sig_unit_mb=128
+    )
+    # G=2 = fallback + overflow only: everything nonzero overflows
+    t.blocks.register(1, 512 * MB, [0])
+    t.blocks.register(2, 600 * MB, [0])  # same sig bucket (512//128 == 600//128)
+    g1 = t.group_for(0, [1])
+    assert g1 == 1 and t.e[1] == 512
+    g2 = t.group_for(0, [2])  # memoized-sig hit on the overflow gid
+    assert g2 == 1
+    assert t.e[1] == 600 and t.u[1] == 601  # ratcheted to the dearer worst
+
+
 def test_group_table_overflow_unpins_after_eviction():
     """A signature that first appeared under table pressure (memoized
     to the overflow gid) must register PROPERLY once eviction frees
