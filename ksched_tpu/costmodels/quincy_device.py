@@ -52,6 +52,10 @@ from .quincy import (
 #: re-exported sentinel (scheduler/device_bulk.py) so callers need one import
 from ..scheduler.device_bulk import PREF_NONE  # noqa: F401
 
+#: distinct overflowed signatures tracked exactly before the counter
+#: degrades to a per-event upper bound (see QuincyGroupTable)
+_OVERFLOW_TRACK_CAP = 1 << 16
+
 
 def _transfer_cost(total: int, local: int, unit_mb: int = 1) -> int:
     return (COST_PER_MB * max(0, total - local)) // (MB * unit_mb)
@@ -132,6 +136,16 @@ class QuincyGroupTable:
         self._gid2sig: Dict[int, tuple] = {}
         #: signatures currently memoized to each class's overflow gid
         self._overflow_sigs: Dict[int, set] = {}
+        #: signatures that have EVER overflowed — never cleared by
+        #: evict_idle, so `overflowed` keeps counting DISTINCT
+        #: signatures even when un-pinned memoizations re-overflow.
+        #: Bounded: past _OVERFLOW_TRACK_CAP distinct signatures the
+        #: set stops growing and the counter increments per overflow
+        #: event instead (an upper bound) — a G_cap-sizing signal that
+        #: large is already saturated, and exact distinctness forever
+        #: would be unbounded history (the thing evict_idle exists to
+        #: avoid).
+        self._overflowed_ever: set = set()
         self._next = 2 * self.C
         self._free: List[int] = []  # evicted gids, reusable
         #: monotonic use clock + last-use stamp per gid (LRU eviction)
@@ -204,8 +218,14 @@ class QuincyGroupTable:
             # upward to cover the costliest overflowed signature. The
             # signature is memoized to the overflow gid so repeated
             # registrations (task multiplicity) don't inflate the
-            # distinct-signatures-dropped counter.
-            self.overflowed += 1
+            # distinct-signatures-dropped counter — and the persistent
+            # ever-overflowed set keeps it distinct across evict_idle
+            # cycles (which un-pin memoizations).
+            if len(self._overflowed_ever) < _OVERFLOW_TRACK_CAP:
+                self._overflowed_ever.add(sig)
+                self.overflowed = len(self._overflowed_ever)
+            elif sig not in self._overflowed_ever:
+                self.overflowed += 1  # upper bound past the cap
             gid = self.C + int(task_class)
             self._sig2gid[sig] = gid
             self._overflow_sigs.setdefault(gid, set()).add(sig)

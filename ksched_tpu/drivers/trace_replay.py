@@ -293,27 +293,58 @@ class TraceReplayDriver:
 
         def flush_window(pending_submit, pending_finish):
             t0 = _time.perf_counter()
-            # Admit before retiring: a task can SUBMIT and FINISH inside
-            # one window, and its finish must find the row just created.
-            if pending_submit:
+            # Ordering inside one batched window: (1) retire finishes
+            # whose task was live at window START (a FAIL followed by a
+            # resubmit in the same window must free the row before the
+            # resubmit lands), (2) admit — skipping duplicate SUBMITs
+            # for a still-live (job, task), the reference scheduler's
+            # duplicate-pod skip (cmd/k8sscheduler/scheduler.go:
+            # 133-136), which would otherwise orphan the first row
+            # forever, (3) retire finishes that target rows created in
+            # THIS window (same-window submit->finish).
+            # A key can appear in pending_finish more than once
+            # (FAIL + FINISH for the same task in one window, with a
+            # resubmit between): only the FIRST occurrence can retire
+            # the window-start row — later ones target the resubmitted
+            # row and must wait for the admit step.
+            pre, post, claimed = [], [], set()
+            for k in pending_finish:
+                if k in self._live_tasks and k not in claimed:
+                    claimed.add(k)
+                    pre.append(k)
+                else:
+                    post.append(k)
+
+            def retire(keys):
+                done_rows = [
+                    self._live_tasks.pop(k)
+                    for k in keys
+                    if k in self._live_tasks
+                ]
+                if done_rows:
+                    self.cluster.complete_tasks(np.asarray(done_rows, np.int32))
+                    stats.finished += len(done_rows)
+
+            retire(pre)
+            fresh, seen = [], set()
+            for ev in pending_submit:
+                key = (ev.job_id, ev.task_index)
+                if key in self._live_tasks or key in seen:
+                    continue
+                seen.add(key)
+                fresh.append(ev)
+            if fresh:
                 jobs = np.asarray(
-                    [ev.job_id % self.num_jobs for ev in pending_submit], np.int32
+                    [ev.job_id % self.num_jobs for ev in fresh], np.int32
                 )
                 classes = np.asarray(
-                    [ev.scheduling_class % 4 for ev in pending_submit], np.int32
+                    [ev.scheduling_class % 4 for ev in fresh], np.int32
                 )
-                abs_rows = self.cluster.add_tasks(len(pending_submit), jobs, classes)
-                for ev, row in zip(pending_submit, abs_rows):
+                abs_rows = self.cluster.add_tasks(len(fresh), jobs, classes)
+                for ev, row in zip(fresh, abs_rows):
                     self._live_tasks[(ev.job_id, ev.task_index)] = int(row)
-                stats.submitted += len(pending_submit)
-            done_rows = [
-                self._live_tasks.pop(k)
-                for k in pending_finish
-                if k in self._live_tasks
-            ]
-            if done_rows:
-                self.cluster.complete_tasks(np.asarray(done_rows, np.int32))
-                stats.finished += len(done_rows)
+                stats.submitted += len(fresh)
+            retire(post)
             result = self.cluster.round()
             stats.round_latencies_s.append(_time.perf_counter() - t0)
             stats.placed += len(result.placed_tasks)
@@ -451,13 +482,24 @@ class DeviceTraceReplayDriver:
                     deferred.append(key)
             carry_finish = deferred
             finished += len(done_rows)
-            # admissions: first n free rows, ascending — the admit rule
+            # admissions: first n free rows, ascending — the admit rule.
+            # Duplicate SUBMITs for a live (job, task) are skipped, not
+            # admitted twice: overwriting row_of would orphan the first
+            # row forever (the reference's duplicate-pod skip,
+            # cmd/k8sscheduler/scheduler.go:133-136).
+            fresh, seen = [], set()
+            for ev in submits:
+                key = (ev.job_id, ev.task_index)
+                if key in row_of or key in seen:
+                    continue
+                seen.add(key)
+                fresh.append(ev)
             free = np.nonzero(~live)[0]
-            n_adm = min(len(submits), len(free))
-            dropped += len(submits) - n_adm
+            n_adm = min(len(fresh), len(free))
+            dropped += len(fresh) - n_adm
             rows = free[:n_adm]
             adm = []
-            for ev, row in zip(submits[:n_adm], rows):
+            for ev, row in zip(fresh[:n_adm], rows):
                 row_of[(ev.job_id, ev.task_index)] = int(row)
                 live[row] = True
                 adm.append(

@@ -17,6 +17,7 @@ that reconstructibility property AND makes it a feature:
 
 from __future__ import annotations
 
+import json
 import pickle
 from typing import Dict, Optional, Tuple
 
@@ -239,11 +240,13 @@ def save_device_checkpoint(cluster, path: str) -> None:
         arrays.update({f"g_{name}": got[name] for name in _DEVICE_GROUPS})
     if cluster.per_job:
         arrays["job_unsched_cost"] = np.asarray(cluster.job_unsched_cost)
+    # meta rides as JSON, not a single int64 array: a future float knob
+    # (fractional discount, alpha) must keep its type on round-trip
+    # instead of truncating silently
     np.savez_compressed(
         path,
         __kind__=np.array("device_bulk"),
-        __meta__=np.array([meta[k] for k in sorted(meta)], np.int64),
-        __meta_keys__=np.array(sorted(meta)),
+        __meta_json__=np.array(json.dumps(meta)),
         **arrays,
     )
 
@@ -262,10 +265,13 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
             f"{path} is not a device_bulk checkpoint (wrong kind or a "
             "bulk/npz checkpoint — use load_bulk_checkpoint for those)"
         )
-    meta = {
-        str(k): int(v)
-        for k, v in zip(data["__meta_keys__"], data["__meta__"])
-    }
+    if "__meta_json__" in data:
+        meta = json.loads(str(data["__meta_json__"]))
+    else:  # pre-r4 checkpoints: all-int meta in a single int64 array
+        meta = {
+            str(k): int(v)
+            for k, v in zip(data["__meta_keys__"], data["__meta__"])
+        }
     if meta["version"] != CHECKPOINT_VERSION:
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     cluster = DeviceBulkCluster(

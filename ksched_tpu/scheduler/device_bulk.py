@@ -260,6 +260,7 @@ class DeviceBulkCluster:
         )
         #: steady-round arrival group draw map (see set_arrival_groups)
         self._arrival_map = jnp.arange(max(self.G, 1), dtype=jnp.int32)
+        self._arrival_n = jnp.int32(max(self.G, 1))
         self._build_programs()
         self.last_stats: Optional[dict] = None
         self.last_admitted = None  # device i32 from the latest add_tasks
@@ -991,12 +992,14 @@ class DeviceBulkCluster:
             )
 
         def steady_round(state: DeviceClusterState, gspec, key, churn_prob,
-                         arrivals, arrival_map):
+                         arrivals, arrival_map, arrival_n):
             """One benchmark round: complete ~churn_prob of running
             tasks, admit `arrivals` new ones (random job/class — or a
-            random GROUP in group mode, drawn through `arrival_map`
-            [Gn] so the host can restrict arrivals to REGISTERED
-            signatures when the table churns under LRU eviction; class
+            random GROUP in group mode, drawn uniformly over the first
+            `arrival_n` entries of `arrival_map` [Gn] so the host can
+            restrict arrivals to REGISTERED signatures when the table
+            churns under LRU eviction — exactly uniform over the
+            registered set, no tiling skew; class
             and job gathered from the group metadata), then schedule.
             Entirely on device so rounds chain without host sync — the
             incremental re-solve regime Flowlessly's daemon mode serves
@@ -1016,7 +1019,9 @@ class DeviceBulkCluster:
             free_rank = jnp.cumsum(~state.live) - 1
             newmask = ~state.live & (free_rank < arrivals)
             if grouped:
-                new_grp = arrival_map[jax.random.randint(k2, (Tcap,), 0, Gn)]
+                new_grp = arrival_map[
+                    jax.random.randint(k2, (Tcap,), 0, arrival_n)
+                ]
                 new_cls = gspec.cls[new_grp]
                 new_job = gspec.job[new_grp]
             else:
@@ -1152,12 +1157,12 @@ class DeviceBulkCluster:
         self._set_machine_jit = jax.jit(set_machine, static_argnums=(2,))
 
         def steady_scan(state, gspec, key0, churn_prob, arrivals, num_rounds,
-                        arrival_map):
+                        arrival_map, arrival_n):
             keys = jax.random.split(key0, num_rounds)
 
             def body(s, k):
                 return steady_round(s, gspec, k, churn_prob, arrivals,
-                                    arrival_map)
+                                    arrival_map, arrival_n)
 
             return lax.scan(body, state, keys)
 
@@ -1307,22 +1312,30 @@ class DeviceBulkCluster:
             int(arrivals),
             int(num_rounds),
             self._arrival_map,
+            self._arrival_n,
         )
         self.last_stats = stats
         return stats
 
     def set_arrival_groups(self, gids) -> None:
-        """Restrict on-device steady-round arrivals to these group ids
-        (tiled/truncated to [G]): with LRU signature eviction the table
-        has FREED rows between maintenance points, and uniform draws
-        over [0, G) would admit tasks into them — zero-signature rows
-        the real policy never populates. Host -> device upload only."""
+        """Restrict on-device steady-round arrivals to these group ids:
+        with LRU signature eviction the table has FREED rows between
+        maintenance points, and uniform draws over [0, G) would admit
+        tasks into them — zero-signature rows the real policy never
+        populates. Draws are EXACTLY uniform over the registered set:
+        the map is padded to [G] but the device draw indexes only its
+        first len(gids) entries (no tiling skew toward low-indexed
+        groups). Host -> device upload only; recompile-free (the map
+        and count are traced args)."""
         if not self.grouped:
             raise ValueError("set_arrival_groups requires group mode")
         g = np.asarray(gids, np.int32)
         if g.size == 0 or ((g < 0) | (g >= self.G)).any():
             raise ValueError("gids must be non-empty, within [0, G)")
+        if g.size > self.G:
+            raise ValueError("more arrival gids than groups")
         self._arrival_map = jnp.asarray(np.resize(g, self.G))
+        self._arrival_n = jnp.int32(g.size)
 
     def run_replay_rounds(self, schedule, seed: int = 0):
         """Replay `schedule` (a staged window schedule — see

@@ -8,7 +8,12 @@ host mirror must predict device row assignment exactly."""
 import numpy as np
 
 from ksched_tpu.drivers.trace_replay import (
+    FAIL,
+    FINISH,
+    SUBMIT,
     DeviceTraceReplayDriver,
+    TraceMachineEvent,
+    TraceTaskEvent,
     synthesize_trace,
 )
 from ksched_tpu.scheduler.device_bulk import DeviceBulkCluster
@@ -131,6 +136,73 @@ def test_same_window_submit_finish_defers_not_leaks():
     assert int(stats["completed"].sum()) == 80
     st = {k: np.asarray(v) for k, v in driver.cluster.fetch_state().items()}
     assert int(st["live"].sum()) == 0, "rows leaked live after the trace"
+
+
+def test_duplicate_submit_skipped_not_leaked():
+    """A duplicate SUBMIT for a live (job, task) — real Google-trace
+    segments contain submit->FAIL->resubmit — must be SKIPPED (the
+    reference's duplicate-pod skip, cmd/k8sscheduler/scheduler.go:
+    133-136), not admitted again: overwriting the row mapping would
+    orphan the first row live forever. A FAIL followed by a resubmit
+    in a later batch must retire the old row and admit a fresh one."""
+    machines = [TraceMachineEvent(0, 0, 0, cpus=4.0)]
+    us = int(1e6)
+    events = [
+        TraceTaskEvent(0, 1, 0, SUBMIT),
+        # window 2: duplicate SUBMIT while (1, 0) is still live
+        TraceTaskEvent(6 * us, 1, 0, SUBMIT),
+        # window 3: FAIL + resubmit batched together, then a final FINISH
+        TraceTaskEvent(12 * us, 1, 0, FAIL),
+        TraceTaskEvent(13 * us, 1, 0, SUBMIT),
+        TraceTaskEvent(18 * us, 1, 0, FINISH),
+    ]
+    driver = DeviceTraceReplayDriver(
+        machines, slots_per_machine=4, num_jobs_hint=2,
+        task_capacity=16, decode_width=None,
+    )
+    schedule = driver.stage(events, window_s=5.0)
+    # original admit + post-FAIL resubmit; the live-duplicate skipped
+    assert schedule["submitted"] == 2
+    assert schedule["finished"] == 2  # the FAIL and the FINISH
+    assert schedule["dropped"] == 0
+    stats = driver.cluster.fetch_stats(driver.replay(schedule))
+    assert stats["converged"].all()
+    assert int(stats["admitted"].sum()) == 2
+    assert int(stats["completed"].sum()) == 2
+    st = {k: np.asarray(v) for k, v in driver.cluster.fetch_state().items()}
+    assert int(st["live"].sum()) == 0, "duplicate SUBMIT leaked a row"
+
+    # the host driver agrees on the same stream
+    from ksched_tpu.drivers.trace_replay import TraceReplayDriver
+
+    host = TraceReplayDriver(machines, slots_per_machine=4, num_jobs_hint=2)
+    hs = host.replay(events, window_s=5.0)
+    assert hs.submitted == 2 and hs.finished == 2
+    assert not host._live_tasks, "host driver leaked a live task"
+
+    # FAIL + resubmit + FINISH all batched into ONE window: the first
+    # finish retires the window-start row, the resubmit admits a fresh
+    # one, and the second finish must target THAT row — not be consumed
+    # as a duplicate of the first (which would leak the new row).
+    events2 = [
+        TraceTaskEvent(0, 1, 0, SUBMIT),
+        TraceTaskEvent(6 * us, 1, 0, FAIL),
+        TraceTaskEvent(7 * us, 1, 0, SUBMIT),
+        TraceTaskEvent(9 * us, 1, 0, FINISH),
+    ]
+    d2 = DeviceTraceReplayDriver(
+        machines, slots_per_machine=4, num_jobs_hint=2,
+        task_capacity=16, decode_width=None,
+    )
+    sch2 = d2.stage(events2, window_s=5.0)
+    assert sch2["submitted"] == 2 and sch2["finished"] == 2
+    st2 = d2.cluster.fetch_stats(d2.replay(sch2))
+    assert int(st2["completed"].sum()) == 2
+    assert int(np.asarray(d2.cluster.fetch_state()["live"]).sum()) == 0
+    h2 = TraceReplayDriver(machines, slots_per_machine=4, num_jobs_hint=2)
+    hs2 = h2.replay(events2, window_s=5.0)
+    assert hs2.submitted == 2 and hs2.finished == 2
+    assert not h2._live_tasks, "same-window FAIL+resubmit+FINISH leaked"
 
 
 def test_stage_mirror_reuses_freed_rows():
