@@ -170,18 +170,19 @@ def iter_windows(
     window_s: float,
     machine_events_until=None,
     max_rounds: Optional[int] = None,
-) -> Iterator[Tuple[List[TraceTaskEvent], List[Tuple[int, int]]]]:
+) -> Iterator[List[TraceTaskEvent]]:
     """Batch a timestamp-ordered task-event stream into scheduling
     windows (the trace analogue of the reference's 2s pod-batch
-    debounce, k8sclient/client.go:153-193). Yields one
-    (submits, finish_keys) pair per non-empty window; calls
+    debounce, k8sclient/client.go:153-193). Yields one STREAM-ORDERED
+    event list per non-empty window — submits and finish-kind events
+    interleaved as the trace carries them, so window_net_ops can
+    replay each task's intra-window lifecycle exactly; calls
     `machine_events_until(t_us)` before each yield so the caller can
     drain machine events up to the window boundary. ONE definition
     shared by the host and device replay drivers so their windowing
     protocols cannot drift."""
     window_us = int(window_s * 1e6)
-    pending_submit: List[TraceTaskEvent] = []
-    pending_finish: List[Tuple[int, int]] = []
+    pending: List[TraceTaskEvent] = []
     window_end = None
     rounds = 0
     for ev in task_events:
@@ -190,21 +191,81 @@ def iter_windows(
             if machine_events_until is not None:
                 machine_events_until(ev.time_us)
         while ev.time_us >= window_end:
-            if pending_submit or pending_finish:
+            if pending:
                 if machine_events_until is not None:
                     machine_events_until(window_end)
-                yield pending_submit, pending_finish
-                pending_submit, pending_finish = [], []
+                yield pending
+                pending = []
                 rounds += 1
                 if max_rounds is not None and rounds >= max_rounds:
                     return
             window_end += window_us
-        if ev.event_type == SUBMIT:
-            pending_submit.append(ev)
-        elif ev.event_type in (FINISH, KILL, FAIL, LOST, EVICT):
-            pending_finish.append((ev.job_id, ev.task_index))
-    if pending_submit or pending_finish:
-        yield pending_submit, pending_finish
+        if ev.event_type == SUBMIT or ev.event_type in (
+            FINISH, KILL, FAIL, LOST, EVICT
+        ):
+            pending.append(ev)
+    if pending:
+        yield pending
+
+
+def window_net_ops(events: List[TraceTaskEvent], is_live):
+    """Collapse one window's events into their NET per-task effect by
+    replaying each task's events in stream order against its
+    window-start liveness (`is_live(key) -> bool`). Batching a window
+    into one scheduling round loses intra-window interleaving; this
+    automaton is the single place that semantics lives, shared by the
+    host and device drivers so they cannot disagree (the round-4
+    review found them diverging on duplicate-SUBMIT/FINISH
+    interleavings).
+
+    Per key, in order: a SUBMIT while live is the reference's
+    duplicate-pod skip (cmd/k8sscheduler/scheduler.go:133-136); a
+    finish-kind event while dead targets an unknown task and is
+    dropped; otherwise submits open a row and finishes close one —
+    the pre-existing row first, then in-window rows.
+
+    Returns (retires, admits, pairs):
+      retires: keys whose PRE-EXISTING row completes this window
+      admits:  SUBMIT events whose new row survives the window
+      pairs:   SUBMIT events admitted AND finished inside the window
+               (a full lifecycle per entry — possibly several per key)
+    """
+    seq: Dict[Tuple[int, int], List[TraceTaskEvent]] = {}
+    order: List[Tuple[int, int]] = []
+    for ev in events:
+        key = (ev.job_id, ev.task_index)
+        if key not in seq:
+            seq[key] = []
+            order.append(key)
+        seq[key].append(ev)
+    retires: List[Tuple[int, int]] = []
+    admits: List[TraceTaskEvent] = []
+    pairs: List[TraceTaskEvent] = []
+    for key in order:
+        pre_live = bool(is_live(key))
+        cur_live = pre_live
+        pre_row_live = pre_live
+        open_submit: Optional[TraceTaskEvent] = None
+        for ev in seq[key]:
+            if ev.event_type == SUBMIT:
+                if cur_live:
+                    continue  # duplicate-pod skip
+                cur_live = True
+                open_submit = ev
+            else:
+                if not cur_live:
+                    continue  # finish for an unknown/dead task
+                cur_live = False
+                if pre_row_live:
+                    retires.append(key)
+                    pre_row_live = False
+                else:
+                    pairs.append(open_submit)
+                open_submit = None
+        if cur_live and open_submit is not None:
+            admits.append(open_submit)
+        # cur_live with no open submit: the pre-existing row survives
+    return retires, admits, pairs
 
 
 @dataclass
@@ -291,48 +352,21 @@ class TraceReplayDriver:
 
         stats = ReplayStats()
 
-        def flush_window(pending_submit, pending_finish):
+        def flush_window(events):
             t0 = _time.perf_counter()
-            # Ordering inside one batched window: (1) retire finishes
-            # whose task was live at window START (a FAIL followed by a
-            # resubmit in the same window must free the row before the
-            # resubmit lands), (2) admit — skipping duplicate SUBMITs
-            # for a still-live (job, task), the reference scheduler's
-            # duplicate-pod skip (cmd/k8sscheduler/scheduler.go:
-            # 133-136), which would otherwise orphan the first row
-            # forever, (3) retire finishes that target rows created in
-            # THIS window (same-window submit->finish).
-            # A key can appear in pending_finish more than once
-            # (FAIL + FINISH for the same task in one window, with a
-            # resubmit between): only the FIRST occurrence can retire
-            # the window-start row — later ones target the resubmitted
-            # row and must wait for the admit step.
-            pre, post, claimed = [], [], set()
-            for k in pending_finish:
-                if k in self._live_tasks and k not in claimed:
-                    claimed.add(k)
-                    pre.append(k)
-                else:
-                    post.append(k)
-
-            def retire(keys):
-                done_rows = [
-                    self._live_tasks.pop(k)
-                    for k in keys
-                    if k in self._live_tasks
-                ]
-                if done_rows:
-                    self.cluster.complete_tasks(np.asarray(done_rows, np.int32))
-                    stats.finished += len(done_rows)
-
-            retire(pre)
-            fresh, seen = [], set()
-            for ev in pending_submit:
-                key = (ev.job_id, ev.task_index)
-                if key in self._live_tasks or key in seen:
-                    continue
-                seen.add(key)
-                fresh.append(ev)
+            # Net per-task window effect from the shared automaton
+            # (window_net_ops): pre-existing rows that complete, new
+            # rows that survive, and full in-window lifecycles (pairs)
+            # — the host path expresses a pair exactly: admit, then
+            # complete before the round runs.
+            retires, admits, pairs = window_net_ops(
+                events, lambda k: k in self._live_tasks
+            )
+            done_rows = [self._live_tasks.pop(k) for k in retires]
+            if done_rows:
+                self.cluster.complete_tasks(np.asarray(done_rows, np.int32))
+                stats.finished += len(done_rows)
+            fresh = admits + pairs
             if fresh:
                 jobs = np.asarray(
                     [ev.job_id % self.num_jobs for ev in fresh], np.int32
@@ -341,23 +375,26 @@ class TraceReplayDriver:
                     [ev.scheduling_class % 4 for ev in fresh], np.int32
                 )
                 abs_rows = self.cluster.add_tasks(len(fresh), jobs, classes)
-                for ev, row in zip(fresh, abs_rows):
+                for ev, row in zip(admits, abs_rows[: len(admits)]):
                     self._live_tasks[(ev.job_id, ev.task_index)] = int(row)
                 stats.submitted += len(fresh)
-            retire(post)
+                pair_rows = np.asarray(abs_rows[len(admits):], np.int32)
+                if len(pair_rows):
+                    self.cluster.complete_tasks(pair_rows)
+                    stats.finished += len(pair_rows)
             result = self.cluster.round()
             stats.round_latencies_s.append(_time.perf_counter() - t0)
             stats.placed += len(result.placed_tasks)
             stats.rounds += 1
 
-        for submits, finishes in iter_windows(
+        for events in iter_windows(
             task_events, window_s,
             machine_events_until=lambda t: self._apply_machine_events_until(
                 t, stats
             ),
             max_rounds=max_rounds,
         ):
-            flush_window(submits, finishes)
+            flush_window(events)
         return stats
 
 
@@ -435,19 +472,21 @@ class DeviceTraceReplayDriver:
         run_replay_rounds takes, with staging metadata (rounds,
         submits, finishes, toggles).
 
-        The device round applies toggles -> completions -> admissions,
-        so a task whose SUBMIT and FINISH land in the SAME window
-        cannot be expressed in one device round (its completion would
-        precede its admission); such finishes are deferred one window
-        — the task is admitted this round and completed the next,
-        preserving the submit/finish counts the host driver reports."""
+        Window semantics come from the shared window_net_ops automaton
+        (exact intra-window lifecycle replay, agreeing with the host
+        driver by construction). The device round applies toggles ->
+        completions -> admissions, so a PAIR (a task admitted AND
+        finished inside one window) cannot complete in its own round
+        — its row is admitted this round and carried to complete in
+        the NEXT round, preserving the submit/finish counts the host
+        driver reports."""
         live = np.zeros(self.Tcap, bool)  # host mirror of the live bitmap
         row_of: Dict[Tuple[int, int], int] = {}
         machine_cursor = 0
 
         windows: List[dict] = []
         pending_toggles: Dict[int, bool] = {}  # dedup keep-last per window
-        carry_finish: List[Tuple[int, int]] = []
+        carry_rows: List[int] = []  # pair rows retiring next window
         submitted = finished = dropped = 0
 
         def machine_events_until(t_us):
@@ -464,44 +503,43 @@ class DeviceTraceReplayDriver:
                 elif ev.event_type == MACHINE_REMOVE:
                     pending_toggles[idx] = False
 
-        def flush_window(submits, finishes):
-            nonlocal carry_finish, pending_toggles
+        def flush_window(events):
+            nonlocal carry_rows, pending_toggles
             nonlocal submitted, finished, dropped
-            # completions first in the mirror (matching the device
-            # round's order); finishes for tasks submitted in THIS
-            # window defer to the next one (see docstring)
-            submitted_keys = {(ev.job_id, ev.task_index) for ev in submits}
-            done_rows = []
-            deferred = []
-            for key in carry_finish + finishes:
-                row = row_of.pop(key, None)
-                if row is not None:
-                    done_rows.append(row)
-                    live[row] = False
-                elif key in submitted_keys:
-                    deferred.append(key)
-            carry_finish = deferred
+            # Net per-task window effect (shared window_net_ops
+            # automaton — identical semantics to the host driver).
+            # Completions first in the mirror (matching the device
+            # round's order): pre-existing retires + pair rows carried
+            # from the previous window.
+            retires, admits, pairs = window_net_ops(
+                events, lambda k: k in row_of
+            )
+            done_rows = list(carry_rows)
+            for key in retires:
+                row = row_of.pop(key)
+                done_rows.append(row)
+            for row in done_rows:
+                live[row] = False
+            carry_rows = []
             finished += len(done_rows)
-            # admissions: first n free rows, ascending — the admit rule.
-            # Duplicate SUBMITs for a live (job, task) are skipped, not
-            # admitted twice: overwriting row_of would orphan the first
-            # row forever (the reference's duplicate-pod skip,
-            # cmd/k8sscheduler/scheduler.go:133-136).
-            fresh, seen = [], set()
-            for ev in submits:
-                key = (ev.job_id, ev.task_index)
-                if key in row_of or key in seen:
-                    continue
-                seen.add(key)
-                fresh.append(ev)
+            # admissions: first n free rows, ascending — the admit
+            # rule. Surviving admits first, then pair rows (admitted
+            # now, completed next round via the carry), so capacity
+            # pressure drops pairs before durable tasks.
+            fresh = admits + pairs
             free = np.nonzero(~live)[0]
             n_adm = min(len(fresh), len(free))
             dropped += len(fresh) - n_adm
             rows = free[:n_adm]
             adm = []
-            for ev, row in zip(fresh[:n_adm], rows):
-                row_of[(ev.job_id, ev.task_index)] = int(row)
+            for i, (ev, row) in enumerate(zip(fresh[:n_adm], rows)):
                 live[row] = True
+                if i < len(admits):
+                    row_of[(ev.job_id, ev.task_index)] = int(row)
+                else:
+                    # completes NEXT round via the carry (counted in
+                    # `finished` when its done_rows entry lands)
+                    carry_rows.append(int(row))
                 adm.append(
                     (ev.job_id % self.num_jobs, ev.scheduling_class % 4)
                 )
@@ -515,16 +553,16 @@ class DeviceTraceReplayDriver:
             )
             pending_toggles = {}
 
-        for submits, finishes in iter_windows(
+        for events in iter_windows(
             task_events, window_s,
             machine_events_until=machine_events_until,
             max_rounds=max_rounds,
         ):
-            flush_window(submits, finishes)
-        if carry_finish and (max_rounds is None or len(windows) < max_rounds):
-            # trace ended with deferred same-window finishes: one extra
+            flush_window(events)
+        if carry_rows and (max_rounds is None or len(windows) < max_rounds):
+            # trace ended with carried pair rows: one extra
             # completion-only window retires them
-            flush_window([], [])
+            flush_window([])
         if not windows:
             raise ValueError(
                 "trace yielded no schedulable windows (no task events, "

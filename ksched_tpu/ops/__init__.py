@@ -97,11 +97,51 @@ def transport_solve(
     )
 
 
+#: the tiered kernel's live set is larger (two cost tiers + resident
+#: caps + per-tier splits) — budget conservatively
+_PALLAS_TIERED_LIVE_TILES = 16
+
+
+def transport_solve_tiered(
+    wLo, wHi, R, supply, col_cap, eps_init, *,
+    alpha: int = 8, max_supersteps: int = 20_000, refine_waves: int = 0,
+):
+    """The tiered (continuation-priced) solve behind the mode switch:
+    the fused tiered Pallas kernel or the XLA phase loop — the
+    preemption-on twin of transport_solve. Bit-identical results both
+    ways. Returns (y, pm, steps, converged); traceable inside
+    jit/scan."""
+    use_pallas, interpret = resolve_pallas()
+    if use_pallas and not interpret:
+        C, Mp = wLo.shape
+        if _PALLAS_TIERED_LIVE_TILES * C * Mp * 4 > _PALLAS_VMEM_BUDGET_BYTES:
+            use_pallas = False
+    if use_pallas:
+        from .transport_pallas import transport_loop_pallas_tiered
+
+        return transport_loop_pallas_tiered(
+            wLo, wHi, R, supply, col_cap, eps_init,
+            alpha=alpha, max_supersteps=max_supersteps, interpret=interpret,
+            refine_waves=refine_waves,
+        )
+    from ..solver.layered import _solve_transport_tiered
+
+    return _solve_transport_tiered(
+        wLo, wHi, R, supply, col_cap, eps_init,
+        alpha=alpha, max_supersteps=max_supersteps,
+        refine_waves=refine_waves,
+    )
+
+
 def __getattr__(name):
     if name == "transport_loop_pallas":
         from .transport_pallas import transport_loop_pallas
 
         return transport_loop_pallas
+    if name == "transport_loop_pallas_tiered":
+        from .transport_pallas import transport_loop_pallas_tiered
+
+        return transport_loop_pallas_tiered
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -110,6 +150,7 @@ def __getattr__(name):
 # must never take. Access it explicitly (module __getattr__).
 __all__ = [
     "transport_solve",
+    "transport_solve_tiered",
     "set_pallas_mode",
     "get_pallas_mode",
     "resolve_pallas",

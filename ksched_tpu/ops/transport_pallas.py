@@ -230,6 +230,280 @@ def _transport_kernel(
     conv_ref[0] = (done & (max_abs == 0)).astype(i32)
 
 
+def _transport_kernel_tiered(
+    wLo_ref, wHi_ref, R_ref, supply_ref, colcap_ref, eps_ref,
+    y_ref, pm_ref, steps_ref, conv_ref,
+    *, C: int, Mp: int, alpha: int, max_supersteps: int,
+    refine_waves: int = 0,
+):
+    """Tiered (continuation-priced) twin of _transport_kernel: per cell
+    the first R units are the residents at wLo = w - discount, the rest
+    pay wHi — a pair of parallel arcs, so cost-scaling push-relabel
+    stays exact with residuals split by tier (the canonical convex-arc
+    split yA = min(y, R), yB = y - yA; see solver/layered.py
+    _transport_loop_tiered, which this kernel matches BIT-FOR-BIT
+    superstep-for-superstep). The preemption-on round was the one
+    iterative solve left on the ~20 us/superstep XLA phase-loop path;
+    fusing it brings the full tiered re-solve onto the same
+    VMEM-resident footing as the backlog solve."""
+    i32 = jnp.int32
+    wLo = wLo_ref[:]                     # [C, Mp]
+    wHi = wHi_ref[:]                     # [C, Mp]
+    supply = supply_ref[:]               # [C, 1]
+    col_cap = colcap_ref[:]              # [1, Mp]
+    eps0 = eps_ref[0]
+    U = jnp.minimum(supply, col_cap)     # [C, Mp] fwd arc capacity
+    R = jnp.minimum(R_ref[:], U)         # resident (cheap-tier) capacity
+
+    def excesses(y, z):
+        e_row = supply - jnp.sum(y, axis=1, keepdims=True)        # [C, 1]
+        e_col = jnp.sum(y, axis=0, keepdims=True) - z             # [1, Mp]
+        e_sink = jnp.sum(z) - jnp.sum(supply)                     # scalar
+        return e_row, e_col, e_sink
+
+    # cold tightening against the CHEAP tier (wLo <= wHi cellwise, so
+    # the zero flow is 0-optimal) — transport_tighten(wLo, U, ...) with
+    # pm0 = zeros
+    live = col_cap > 0
+    pm0 = jnp.where(live, i32(0), -_BIG_D)
+    has_arc = U > 0
+    pr0 = jnp.max(jnp.where(has_arc, pm0 - wLo, -_BIG_D), axis=1,
+                  keepdims=True)
+    pr0 = jnp.where(jnp.any(has_arc, axis=1, keepdims=True), pr0, i32(0))
+    psink0 = jnp.min(jnp.where(live, pm0, _BIG_D)).reshape(1, 1)
+    psink0 = jnp.where(jnp.any(live), psink0, i32(0))
+
+    def saturate(y, z, pr, pm, psink):
+        rcl = wLo + pr - pm
+        rch = wHi + pr - pm
+        yA = jnp.minimum(y, R)
+        yB = y - yA
+        yA2 = jnp.where(rcl < 0, R, jnp.where(rcl > 0, i32(0), yA))
+        yB2 = jnp.where(rch < 0, U - R, jnp.where(rch > 0, i32(0), yB))
+        rcs = pm - psink
+        z2 = jnp.where(rcs < 0, col_cap, jnp.where(rcs > 0, i32(0), z))
+        return yA2 + yB2, z2
+
+    def saturate_eps(y, z, pr, pm, psink, eps):
+        rcl = wLo + pr - pm
+        rch = wHi + pr - pm
+        yA = jnp.minimum(y, R)
+        yB = y - yA
+        yA2 = jnp.where(rcl < -eps, R, jnp.where(rcl > eps, i32(0), yA))
+        yB2 = jnp.where(rch < -eps, U - R, jnp.where(rch > eps, i32(0), yB))
+        rcs = pm - psink
+        z2 = jnp.where(rcs < -eps, col_cap, jnp.where(rcs > eps, i32(0), z))
+        return yA2 + yB2, z2
+
+    def price_refine(y, z, pr, pm, psink, eps):
+        """_price_refine_tiered: each tier's residuals contribute their
+        own Bellman-Ford constraints. min-reductions and selects only."""
+        def body(_, state):
+            pr, pm, psink = state
+            yA = jnp.minimum(y, R)
+            yB = y - yA
+            bound_m = jnp.minimum(
+                jnp.min(jnp.where(R - yA > 0, wLo + pr + eps, _BIG),
+                        axis=0, keepdims=True),
+                jnp.min(jnp.where((U - R) - yB > 0, wHi + pr + eps, _BIG),
+                        axis=0, keepdims=True),
+            )
+            pm2 = jnp.maximum(jnp.minimum(pm, bound_m), -_BIG_D)
+            pm2 = jnp.minimum(pm2, jnp.where(z > 0, psink + eps, _BIG))
+            bound_r = jnp.minimum(
+                jnp.min(jnp.where(yA > 0, pm2 - wLo + eps, _BIG), axis=1,
+                        keepdims=True),
+                jnp.min(jnp.where(yB > 0, pm2 - wHi + eps, _BIG), axis=1,
+                        keepdims=True),
+            )
+            pr2 = jnp.maximum(jnp.minimum(pr, bound_r), -_BIG_D)
+            bound_s = jnp.min(
+                jnp.where(col_cap - z > 0, pm2 + eps, _BIG)
+            ).reshape(1, 1)
+            psink2 = jnp.maximum(jnp.minimum(psink, bound_s), -_BIG_D)
+            return pr2, pm2, psink2
+
+        return lax.fori_loop(0, refine_waves, body, (pr, pm, psink))
+
+    def superstep(y, z, pr, pm, psink, eps):
+        e_row, e_col, e_sink = excesses(y, z)
+        yA = jnp.minimum(y, R)
+        yB = y - yA
+        rcl = wLo + pr - pm
+        rch = wHi + pr - pm
+
+        # rows push forward: tier-A residual at wLo, tier-B at wHi
+        rA = R - yA
+        rB = (U - R) - yB
+        r_adm = jnp.where((rA > 0) & (rcl < 0), rA, i32(0)) + jnp.where(
+            (rB > 0) & (rch < 0), rB, i32(0)
+        )
+        excl = _cumsum(r_adm, 1, Mp) - r_adm
+        delta_f = jnp.clip(e_row - excl, 0, r_adm)
+
+        # columns push: sink entry first, then dear-tier returns, then
+        # cheap — the same exclusive-prefix order as the XLA loop's
+        # [sink; yB rows; yA rows] concatenation
+        r_s = col_cap - z
+        adm_s = jnp.where((r_s > 0) & (pm - psink < 0), r_s, i32(0))
+        rcb_hi = pm - pr - wHi
+        rcb_lo = pm - pr - wLo
+        adm_bh = jnp.where((yB > 0) & (rcb_hi < 0), yB, i32(0))
+        adm_bl = jnp.where((yA > 0) & (rcb_lo < 0), yA, i32(0))
+        excl_bh = adm_s + (_cumsum(adm_bh, 0, C) - adm_bh)
+        excl_bl = (
+            adm_s
+            + jnp.sum(adm_bh, axis=0, keepdims=True)
+            + (_cumsum(adm_bl, 0, C) - adm_bl)
+        )
+        delta_s = jnp.clip(e_col, 0, adm_s)
+        delta_bh = jnp.clip(e_col - excl_bh, 0, adm_bh)
+        delta_bl = jnp.clip(e_col - excl_bl, 0, adm_bl)
+        delta_b = delta_bh + delta_bl
+
+        # sink pushes back (tier-less)
+        zb_adm = jnp.where((z > 0) & (psink - pm < 0), z, i32(0))
+        excl_zb = _cumsum(zb_adm, 1, Mp) - zb_adm
+        delta_zb = jnp.clip(e_sink - excl_zb, 0, zb_adm)
+
+        y2 = y + delta_f - delta_b
+        z2 = z + delta_s - delta_zb
+
+        # jump relabels (candidates consider both tiers' residuals)
+        pushed_row = jnp.sum(delta_f, axis=1, keepdims=True)
+        cand_row = jnp.maximum(
+            jnp.max(jnp.where(rA > 0, pm - wLo, -_BIG), axis=1,
+                    keepdims=True),
+            jnp.max(jnp.where(rB > 0, pm - wHi, -_BIG), axis=1,
+                    keepdims=True),
+        )
+        pr2 = jnp.where((e_row > 0) & (pushed_row == 0), cand_row - eps, pr)
+
+        pushed_col = delta_s + jnp.sum(delta_b, axis=0, keepdims=True)
+        cand_col = jnp.maximum(
+            jnp.maximum(
+                jnp.max(jnp.where(yA > 0, pr + wLo, -_BIG), axis=0,
+                        keepdims=True),
+                jnp.max(jnp.where(yB > 0, pr + wHi, -_BIG), axis=0,
+                        keepdims=True),
+            ),
+            jnp.where(r_s > 0, psink, -_BIG),
+        )
+        pm2 = jnp.where((e_col > 0) & (pushed_col == 0), cand_col - eps, pm)
+
+        pushed_sink = jnp.sum(delta_zb)
+        cand_sink = jnp.max(jnp.where(z > 0, pm, -_BIG))
+        psink2 = jnp.where(
+            (e_sink > 0) & (pushed_sink == 0), cand_sink - eps, psink
+        )
+        return y2, z2, pr2, pm2, psink2
+
+    def phase_cond(state):
+        *_rest, steps, done = state
+        return ~done & (steps < max_supersteps)
+
+    def phase_body(state):
+        y, z, pr, pm, psink, eps, steps, done = state
+        e_row, e_col, e_sink = excesses(y, z)
+        any_active = jnp.any(e_row > 0) | jnp.any(e_col > 0) | (e_sink > 0)
+
+        def do_step(_):
+            y2, z2, pr2, pm2, psink2 = superstep(y, z, pr, pm, psink, eps)
+            return y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False)
+
+        def next_phase(_):
+            finished = eps <= 1
+            new_eps = jnp.maximum(i32(1), eps // alpha)
+            if refine_waves:
+                pr2, pm2, psink2 = price_refine(y, z, pr, pm, psink, new_eps)
+                y2, z2 = saturate_eps(y, z, pr2, pm2, psink2, new_eps)
+            else:
+                pr2, pm2, psink2 = pr, pm, psink
+                y2, z2 = saturate(y, z, pr, pm, psink)
+            return (
+                jnp.where(finished, y, y2),
+                jnp.where(finished, z, z2),
+                jnp.where(finished, pr, pr2),
+                jnp.where(finished, pm, pm2),
+                jnp.where(finished, psink, psink2),
+                jnp.where(finished, eps, new_eps),
+                steps,
+                finished,
+            )
+
+        return lax.cond(any_active, do_step, next_phase, operand=None)
+
+    y0 = jnp.zeros((C, Mp), i32)
+    z0 = jnp.zeros((1, Mp), i32)
+    state = (y0, z0, pr0, pm0, psink0, eps0, i32(0), jnp.bool_(False))
+    y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
+        phase_cond, phase_body, state
+    )
+    e_row, e_col, e_sink = excesses(y, z)
+    max_abs = jnp.maximum(
+        jnp.max(jnp.abs(e_row)),
+        jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink)),
+    )
+    y_ref[:] = y
+    pm_ref[:] = pm
+    steps_ref[0] = steps
+    conv_ref[0] = (done & (max_abs == 0)).astype(i32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("alpha", "max_supersteps", "interpret", "refine_waves"),
+)
+def transport_loop_pallas_tiered(
+    wLo, wHi, R, supply, col_cap, eps_init,
+    alpha: int = 8,
+    max_supersteps: int = 20_000,
+    interpret: bool = False,
+    refine_waves: int = 0,
+):
+    """Drop-in twin of solver/layered.py `_transport_loop_tiered`'s
+    public result (y, pm, steps, converged), one fused kernel per
+    solve. wLo/wHi: int32[C, Mp] scaled tier costs; R: int32[C, Mp]
+    resident capacities; supply: int32[C]; col_cap: int32[Mp]."""
+    C, Mp = wLo.shape
+    y, pm, steps, conv = pl.pallas_call(
+        functools.partial(
+            _transport_kernel_tiered,
+            C=C, Mp=Mp, alpha=alpha, max_supersteps=max_supersteps,
+            refine_waves=refine_waves,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((C, Mp), jnp.int32),
+            jax.ShapeDtypeStruct((1, Mp), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        interpret=interpret,
+    )(
+        wLo.astype(jnp.int32),
+        wHi.astype(jnp.int32),
+        R.astype(jnp.int32),
+        supply.astype(jnp.int32).reshape(C, 1),
+        col_cap.astype(jnp.int32).reshape(1, Mp),
+        eps_init.astype(jnp.int32).reshape(1),
+    )
+    return y, pm.reshape(Mp), steps[0], conv[0] != 0
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("alpha", "max_supersteps", "interpret", "refine_waves"),

@@ -226,8 +226,12 @@ def save_device_checkpoint(cluster, path: str) -> None:
         "alpha": cluster.alpha,
         "preemption": int(cluster.preemption),
         "continuation_discount": cluster.continuation_discount,
+        "preempt_every": cluster.preempt_every,
+        "preempt_drift": cluster.preempt_drift,
+        "track_realized_cost": int(cluster.track_realized_cost),
         "num_groups": cluster.G if cluster.grouped else 0,
-        "active_groups_cap": cluster.active_groups_cap,
+        # the full compaction ladder (a JSON list; int in pre-r4 saves)
+        "active_groups_cap": list(cluster.active_groups_caps),
         "refine_waves": cluster.refine_waves,
         "per_job": int(cluster.per_job),
     }
@@ -292,6 +296,9 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
         ),
         preemption=bool(meta["preemption"]),
         continuation_discount=meta["continuation_discount"],
+        preempt_every=meta.get("preempt_every", 1),
+        preempt_drift=meta.get("preempt_drift", 0),
+        track_realized_cost=bool(meta.get("track_realized_cost", 0)),
         num_groups=meta["num_groups"],
         active_groups_cap=meta["active_groups_cap"],
         refine_waves=meta["refine_waves"],

@@ -108,6 +108,9 @@ class DeviceBulkCluster:
         job_unsched_cost: Optional[np.ndarray] = None,
         preemption: bool = False,
         continuation_discount: int = 1,
+        preempt_every: int = 1,
+        preempt_drift: int = 0,
+        track_realized_cost: bool = False,
         num_groups: int = 0,
         active_groups_cap: int = 256,
         refine_waves: int = 8,
@@ -150,11 +153,25 @@ class DeviceBulkCluster:
                     "escape costs (GroupSpec.u) subsume per-job unsched costs"
                 )
             self.G = int(num_groups)
-        if active_groups_cap < 1:
-            raise ValueError("active_groups_cap must be >= 1")
-        # rows the COMPACTED grouped solve can hold (rounds whose
-        # backlog touches more groups take the full-width solve)
-        self.active_groups_cap = int(min(active_groups_cap, max(self.G, 1)))
+        # rows the COMPACTED grouped solve can hold. An int is one
+        # compaction width; a sequence is a LADDER of widths — the
+        # round picks the smallest width that fits the live active-row
+        # count (nested lax.cond, each width compiled once), so
+        # diversity-pressure configs whose active set exceeds the first
+        # cap degrade to a mid-width solve instead of jumping straight
+        # to full G width (VERDICT r3 #2: the multiblock tail was
+        # full-width 512-row solves past a single 256-row cap).
+        if isinstance(active_groups_cap, (int, np.integer)):
+            caps = (int(active_groups_cap),)
+        else:
+            caps = tuple(int(c) for c in active_groups_cap)
+        if not caps or any(c < 1 for c in caps):
+            raise ValueError("active_groups_cap entries must be >= 1")
+        caps = tuple(sorted({min(c, max(self.G, 1)) for c in caps}))
+        self.active_groups_caps = caps
+        #: largest ladder width (back-compat scalar view; == the single
+        #: cap when an int was passed)
+        self.active_groups_cap = caps[-1]
         # Price refinement between eps phases (solver/layered.py
         # _price_refine) for the iterative solves. Default ON for the
         # device path: measured 2.2x fewer supersteps on contended
@@ -175,6 +192,33 @@ class DeviceBulkCluster:
         # tiered transport (solver/layered.py transport_fori_tiered).
         self.preemption = bool(preemption)
         self.continuation_discount = int(continuation_discount)
+        # Stability-aware (incremental) preemption: the reference keeps
+        # round cost proportional to the DELTA even with preemption on
+        # (placement/solver.go:60-90 — running tasks keep their arcs,
+        # the incremental solver re-prices only changes). The TPU form:
+        # scanned rounds run the cheap incremental core (residents
+        # pinned, bounded backlog decode) and a FULL tiered re-solve
+        # fires every `preempt_every` rounds OR when the running-class
+        # census has drifted by more than `preempt_drift` task
+        # positions since the last full solve (L1 distance, device-
+        # computed) — so migration opportunities accumulate bounded
+        # staleness instead of being re-derived from scratch every
+        # round. preempt_every=1 (default) is the pure per-round
+        # re-solve; preempt_drift=0 disables the drift trigger.
+        self.preempt_every = int(preempt_every)
+        self.preempt_drift = int(preempt_drift)
+        if self.preempt_every < 1:
+            raise ValueError("preempt_every must be >= 1")
+        if self.preempt_drift < 0:
+            raise ValueError("preempt_drift must be >= 0")
+        self.hybrid_preempt = self.preemption and (
+            self.preempt_every > 1 or self.preempt_drift > 0
+        )
+        # Opt-in quality metric: pricing the whole assignment costs an
+        # extra cost_fn + Tcap gather per round INSIDE the timed scan —
+        # the parity tests turn it on; benches leave it off so the
+        # metric cannot inflate the latencies it exists to defend.
+        self.track_realized_cost = bool(track_realized_cost)
         if self.preemption:
             if continuation_discount < 0:
                 raise ValueError("continuation_discount must be >= 0")
@@ -243,6 +287,13 @@ class DeviceBulkCluster:
             machine_enabled=jnp.ones(self.M, jnp.bool_),
             grp=jnp.zeros(self.Tcap, jnp.int32),
         )
+        # stability-aware preemption bookkeeping (see preempt_every):
+        # the running-class census at the last FULL re-solve and the
+        # rounds elapsed since. k starts saturated so the first scanned
+        # round is a full solve (host mutations before it are unseen
+        # drift).
+        self._hyb_census = jnp.zeros((self.M, self.C), jnp.int32)
+        self._hyb_k = jnp.int32(self.preempt_every - 1)
         # Benign defaults until set_groups: every group is class 0 /
         # job 0 at the scalar costs with no preferences.
         self.groups = GroupSpec(
@@ -286,10 +337,14 @@ class DeviceBulkCluster:
         # wins regardless of mode (e.g. per-job rows at trace scale:
         # 256 groups x 12.5k machines). Static choice per geometry.
         use_sorted_decode = grouped or (Gn * M >= (1 << 21))
-        active_cap = self.active_groups_cap
+        active_caps = self.active_groups_caps
         class_degenerate = self.class_degenerate
         row_constant = self.row_constant
         preempt, discount = self.preemption, self.continuation_discount
+        hybrid = self.hybrid_preempt
+        preempt_every = self.preempt_every
+        preempt_drift = self.preempt_drift
+        track_realized = self.track_realized_cost
         refine_waves = self.refine_waves
         # Per-row (group) escape costs: row g = j*C + c escapes at job
         # j's unsched cost; without per-job costs every row uses the
@@ -673,30 +728,49 @@ class DeviceBulkCluster:
                         operand=None,
                     )
 
-                Gc = active_cap
-                if Gc < Gn:
+                caps = tuple(c for c in active_caps if c < Gn)
+                n_active_rows = jnp.sum((supply > 0).astype(i32))
+                if caps:
                     act = supply > 0
                     order = jnp.argsort(~act, stable=True)
-                    sel = order[:Gc]
-                    valid_c = act[sel]
-                    fits = jnp.sum(act.astype(i32)) <= i32(Gc)
+                    n_act = n_active_rows
 
-                    def compact_path(_):
-                        sup_c = jnp.where(valid_c, supply[sel], i32(0))
-                        y_c, s_c, c_c = grouped_solve(
-                            wS[sel], wS1[sel], sup_c, ground[sel]
-                        )
-                        y_f = jnp.zeros((Gn, Mp), i32).at[sel].add(
-                            jnp.where(valid_c[:, None], y_c, i32(0))
-                        )
-                        return y_f, s_c, c_c
+                    def compact_at(Gc):
+                        sel = order[:Gc]
+                        valid_c = act[sel]
+
+                        def path(_):
+                            sup_c = jnp.where(valid_c, supply[sel], i32(0))
+                            y_c, s_c, c_c = grouped_solve(
+                                wS[sel], wS1[sel], sup_c, ground[sel]
+                            )
+                            y_f = jnp.zeros((Gn, Mp), i32).at[sel].add(
+                                jnp.where(valid_c[:, None], y_c, i32(0))
+                            )
+                            return y_f, s_c, c_c
+
+                        return path
 
                     def full_path(_):
                         return grouped_solve(wS, wS1, supply, ground)
 
-                    y, solve_steps, converged = lax.cond(
-                        fits, compact_path, full_path, operand=None
-                    )
+                    # ladder: smallest width that fits n_act wins; the
+                    # widths are static (one compiled solve each), the
+                    # choice is dynamic — no recompile as the live
+                    # signature count drifts between maintenance points
+                    def make_rung(Gc, wider):
+                        def rung(_):
+                            return lax.cond(
+                                n_act <= i32(Gc), compact_at(Gc), wider,
+                                operand=None,
+                            )
+
+                        return rung
+
+                    branch = full_path
+                    for Gc in reversed(caps):
+                        branch = make_rung(Gc, branch)
+                    y, solve_steps, converged = branch(None)
                 else:
                     y, solve_steps, converged = grouped_solve(
                         wS, wS1, supply, ground
@@ -757,6 +831,9 @@ class DeviceBulkCluster:
                 # (placement/solver.go:169-170)
                 "supersteps": solve_steps,
             }
+            if grouped:
+                # which compaction rung carried the solve (ladder tuning)
+                stats["active_groups"] = n_active_rows
             return state._replace(pu=new_pu, pu_running=pu_running), stats
 
         def round_core_preempt(state: DeviceClusterState, gspec=None,
@@ -934,6 +1011,84 @@ class DeviceBulkCluster:
             }
             return state._replace(pu=new_pu, pu_running=pu_running), stats
 
+        def realized_cluster_cost(state: DeviceClusterState, gspec):
+            """Price the CURRENT assignment at this state's census:
+            every placed task pays its group's effective route cost on
+            its machine, every unplaced live task pays its group's
+            escape cost. One number both preemption regimes share, so
+            the stability-aware scheme's objective drift vs the
+            full-re-solve-every-round regime is directly measurable
+            (the parity contract of VERDICT r3 #1)."""
+            if cost_fn is not None:
+                cost_cm = cost_fn(census_of(state)).astype(i32)
+            else:
+                cost_cm = jnp.zeros((C, M), i32)
+            if grouped:
+                cost_eff, _ = group_costs(gspec, cost_cm)
+            else:
+                cost_gm = jnp.tile(cost_cm, (J, 1)) if per_job else cost_cm
+                cost_eff = cost_gm + i32(e_cost)
+            if grouped:
+                g_t = state.grp
+            else:
+                g_t = (state.job * i32(C) + state.cls) if per_job else state.cls
+            on = state.live & (state.pu >= 0)
+            m_t = jnp.clip(state.pu, 0, num_pus - 1) // P
+            g_c = jnp.clip(g_t, 0, Gn - 1)
+            c_task = cost_eff[g_c, m_t]
+            u_g = gspec.u if grouped else u_row
+            esc = u_g[g_c]
+            # int32 is ample: Tcap * max cost stays well under 2^31 for
+            # every wired model (costs clamp at ~2.5k, escape costs a
+            # few units above)
+            return (
+                jnp.sum(jnp.where(on, c_task, i32(0)), dtype=i32)
+                + jnp.sum(jnp.where(state.live & ~on, esc, i32(0)), dtype=i32)
+            )
+
+        def hybrid_round(state, census_ref, k_since, gspec, window_offset):
+            """Stability-aware preemption round (see preempt_every /
+            preempt_drift in __init__): the cheap incremental core
+            (residents pinned, bounded backlog decode) serves steady
+            rounds; the full tiered re-solve fires on schedule or when
+            the running census drifts past the threshold. Both cores
+            live under one lax.cond — only the taken branch executes,
+            so round cost tracks the delta, as the reference's
+            incremental solver does (placement/solver.go:60-90)."""
+            cen = census_of(state)
+            drift = jnp.sum(jnp.abs(cen - census_ref), dtype=i32)
+            do_full = k_since + 1 >= i32(preempt_every)
+            if preempt_drift > 0:
+                do_full = do_full | (drift >= i32(preempt_drift))
+
+            def full_branch(_):
+                s2, st = round_core_preempt(
+                    state, gspec, decode_width=None, window_offset=None
+                )
+                return s2, census_of(s2), st
+
+            def incr_branch(_):
+                s2, st = round_core(
+                    state, gspec,
+                    decode_width=steady_decode_width,
+                    window_offset=window_offset,
+                )
+                st = dict(st)
+                st.pop("active_groups", None)  # preempt core has none
+                st["migrated"] = i32(0)
+                st["preempted"] = i32(0)
+                return s2, census_ref, st
+
+            state2, census_ref2, stats = lax.cond(
+                do_full, full_branch, incr_branch, operand=None
+            )
+            k_since2 = jnp.where(do_full, i32(0), k_since + 1)
+            stats["full_round"] = do_full
+            stats["census_drift"] = drift
+            if track_realized:
+                stats["realized_cost"] = realized_cluster_cost(state2, gspec)
+            return state2, census_ref2, k_since2, stats
+
         def admit(state: DeviceClusterState, jobs, classes, groups, count):
             """Occupy the first `count` free rows with the first `count`
             entries of (jobs, classes, groups). Returns (state,
@@ -991,7 +1146,7 @@ class DeviceBulkCluster:
                 pu_running=pu_running,
             )
 
-        def steady_round(state: DeviceClusterState, gspec, key, churn_prob,
+        def steady_round(carry, gspec, key, churn_prob,
                          arrivals, arrival_map, arrival_n):
             """One benchmark round: complete ~churn_prob of running
             tasks, admit `arrivals` new ones (random job/class — or a
@@ -1004,6 +1159,10 @@ class DeviceBulkCluster:
             Entirely on device so rounds chain without host sync — the
             incremental re-solve regime Flowlessly's daemon mode serves
             in the reference (placement/solver.go:60-90)."""
+            if hybrid:
+                state, census_ref, k_since = carry
+            else:
+                state = carry
             k1, k2, k3, k4 = jax.random.split(key, 4)
             placed = state.live & (state.pu >= 0)
             done = placed & (
@@ -1042,7 +1201,12 @@ class DeviceBulkCluster:
             # no pending task can be starved by earlier-row escapees.
             # Preemption mode bounds its MOVER decode the same way
             # (stays need no decode; movers are ~churn-sized).
-            if preempt:
+            if hybrid:
+                state, census_ref, k_since, stats = hybrid_round(
+                    state, census_ref, k_since, gspec,
+                    jax.random.randint(k4, (), 0, 1 << 30),
+                )
+            elif preempt:
                 state, stats = round_core_preempt(
                     state, gspec,
                     decode_width=steady_decode_width,
@@ -1057,9 +1221,10 @@ class DeviceBulkCluster:
                 )
             stats["completed"] = jnp.sum(done, dtype=i32)
             stats["admitted"] = admitted
-            return state, stats
+            out = (state, census_ref, k_since) if hybrid else state
+            return out, stats
 
-        def replay_round(state, gspec, xs):
+        def replay_round(carry, gspec, xs):
             """One trace-replay round: machine toggles (with evictions),
             completions, admissions, then the scheduling round — the
             whole round's events pre-staged as fixed-width device
@@ -1068,6 +1233,10 @@ class DeviceBulkCluster:
             cmd/k8sscheduler/scheduler.go:120-188: host batches events
             into windows ahead of time, device consumes them without
             per-round host round-trips)."""
+            if hybrid:
+                state, census_ref, k_since = carry
+            else:
+                state = carry
             aj, ac, ag, an, dr, dn, ti, ton, tn, key = xs
             Emax = ti.shape[0]
             Dmax = dr.shape[0]
@@ -1120,7 +1289,12 @@ class DeviceBulkCluster:
             )
             admitted = jnp.sum(newmask, dtype=i32)
 
-            if preempt:
+            if hybrid:
+                state, census_ref, k_since, stats = hybrid_round(
+                    state, census_ref, k_since, gspec,
+                    jax.random.randint(key, (), 0, 1 << 30),
+                )
+            elif preempt:
                 state, stats = round_core_preempt(
                     state, gspec,
                     decode_width=steady_decode_width,
@@ -1135,9 +1309,10 @@ class DeviceBulkCluster:
             stats["evicted"] = evicted
             stats["admitted"] = admitted
             stats["completed"] = jnp.sum(done, dtype=i32)
-            return state, stats
+            out = (state, census_ref, k_since) if hybrid else state
+            return out, stats
 
-        def replay_scan(state, gspec, aj, ac, ag, an, dr, dn, ti, ton, tn,
+        def replay_scan(carry, gspec, aj, ac, ag, an, dr, dn, ti, ton, tn,
                         key0):
             keys = jax.random.split(key0, aj.shape[0])
 
@@ -1145,7 +1320,7 @@ class DeviceBulkCluster:
                 return replay_round(s, gspec, xs)
 
             return lax.scan(
-                body, state, (aj, ac, ag, an, dr, dn, ti, ton, tn, keys)
+                body, carry, (aj, ac, ag, an, dr, dn, ti, ton, tn, keys)
             )
 
         self._replay_scan_jit = jax.jit(replay_scan)
@@ -1155,8 +1330,9 @@ class DeviceBulkCluster:
         self._admit_jit = jax.jit(admit)
         self._complete_jit = jax.jit(complete)
         self._set_machine_jit = jax.jit(set_machine, static_argnums=(2,))
+        self._census_jit = jax.jit(census_of)
 
-        def steady_scan(state, gspec, key0, churn_prob, arrivals, num_rounds,
+        def steady_scan(carry, gspec, key0, churn_prob, arrivals, num_rounds,
                         arrival_map, arrival_n):
             keys = jax.random.split(key0, num_rounds)
 
@@ -1164,7 +1340,7 @@ class DeviceBulkCluster:
                 return steady_round(s, gspec, k, churn_prob, arrivals,
                                     arrival_map, arrival_n)
 
-            return lax.scan(body, state, keys)
+            return lax.scan(body, carry, keys)
 
         self._steady_scan_jit = jax.jit(steady_scan, static_argnums=(4, 5))
 
@@ -1289,11 +1465,29 @@ class DeviceBulkCluster:
             self.state, jnp.int32(machine_index), bool(enabled)
         )
 
+    def _scan_carry(self):
+        """Scan carry: bare state, or (state, census_ref, k_since) in
+        stability-aware preemption mode."""
+        if self.hybrid_preempt:
+            return (self.state, self._hyb_census, self._hyb_k)
+        return self.state
+
+    def _store_carry(self, carry):
+        if self.hybrid_preempt:
+            self.state, self._hyb_census, self._hyb_k = carry
+        else:
+            self.state = carry
+
     def round(self) -> dict:
         """One scheduling round; returns un-fetched device stats (call
         fetch_stats() to materialize — the analogue of the reference's
-        binding push AFTER the timed region)."""
+        binding push AFTER the timed region). In stability-aware
+        preemption mode this one-shot round is always a FULL tiered
+        re-solve and resets the drift reference."""
         self.state, stats = self._round_jit(self.state, self.groups)
+        if self.hybrid_preempt:
+            self._hyb_census = self._census_jit(self.state)
+            self._hyb_k = jnp.int32(0)
         self.last_stats = stats
         return stats
 
@@ -1304,8 +1498,8 @@ class DeviceBulkCluster:
         stacked stats (device arrays, un-fetched). In group mode,
         arrivals draw their group through the arrival map (identity by
         default; see set_arrival_groups)."""
-        self.state, stats = self._steady_scan_jit(
-            self.state,
+        carry, stats = self._steady_scan_jit(
+            self._scan_carry(),
             self.groups,
             jax.random.PRNGKey(seed),
             jnp.float32(churn_prob),
@@ -1314,6 +1508,7 @@ class DeviceBulkCluster:
             self._arrival_map,
             self._arrival_n,
         )
+        self._store_carry(carry)
         self.last_stats = stats
         return stats
 
@@ -1343,8 +1538,8 @@ class DeviceBulkCluster:
         scanned device program: K rounds of machine toggles +
         completions + admissions + solve chained without host sync.
         Returns stacked stats (device arrays, un-fetched)."""
-        self.state, stats = self._replay_scan_jit(
-            self.state,
+        carry, stats = self._replay_scan_jit(
+            self._scan_carry(),
             self.groups,
             jnp.asarray(schedule["adm_job"]),
             jnp.asarray(schedule["adm_cls"]),
@@ -1357,6 +1552,7 @@ class DeviceBulkCluster:
             jnp.asarray(schedule["tog_n"]),
             jax.random.PRNGKey(seed),
         )
+        self._store_carry(carry)
         self.last_stats = stats
         return stats
 

@@ -631,31 +631,51 @@ def solve_single_class_tiered(wLo, wHi, R, supply, col_cap):
     return y2[:Mp1] + y2[Mp1:]
 
 
+def _solve_transport_tiered(wLo, wHi, R, supply, col_cap, eps_init,
+                            alpha: int = 8, max_supersteps: int = 20_000,
+                            refine_waves: int = 0):
+    """XLA form of the tiered solve behind ops.transport_solve_tiered
+    (the fused kernel is ops/transport_pallas.py
+    transport_loop_pallas_tiered — bit-identical)."""
+    R = jnp.minimum(R, jnp.minimum(supply[:, None], col_cap[None, :]))
+    U = jnp.minimum(supply[:, None], col_cap[None, :])
+    y, _z, pm, steps, conv = _transport_loop_tiered(
+        wLo, wHi, R, U, supply, col_cap, eps_init, alpha, max_supersteps,
+        refine_waves=refine_waves,
+    )
+    return y, pm, steps, conv
+
+
 def transport_fori_tiered(wLo, wHi, R, supply, col_cap, num_supersteps: int,
                           alpha: int = 8, eps0: Optional[int] = None,
                           refine_waves: int = 0):
     """Bounded tiered transport solve, embeddable in jitted programs —
-    the preemption-on twin of transport_fori. Runs as the XLA phase
-    loop (no fused Pallas variant yet; the tiered residual rules double
-    the per-superstep mask work, so the kernel port is a separate
-    lift). Single-row instances take the exact closed form. Returns
-    (y, pm, steps, converged)."""
+    the preemption-on twin of transport_fori. Dispatches through
+    ops.transport_solve_tiered: the fused tiered Pallas kernel on TPU
+    (~a handful of us/superstep, VMEM-resident), the XLA phase loop
+    elsewhere — bit-identical either way. Single-row instances take
+    the exact closed form. Returns (y, pm, steps, converged)."""
     C, Mp1 = wLo.shape
     i32 = jnp.int32
-    R = jnp.minimum(R, jnp.minimum(supply[:, None], col_cap[None, :]))
-    U = jnp.minimum(supply[:, None], col_cap[None, :])
     if C == 1:
-        y = solve_single_class_tiered(wLo[0], wHi[0], R[0], supply[0], col_cap)
+        R1 = jnp.minimum(
+            R, jnp.minimum(supply[:, None], col_cap[None, :])
+        )
+        y = solve_single_class_tiered(
+            wLo[0], wHi[0], R1[0], supply[0], col_cap
+        )
         return y[None, :], jnp.zeros_like(col_cap), i32(0), jnp.bool_(True)
+
+    from ..ops import transport_solve_tiered
 
     eps_full = jnp.maximum(jnp.max(jnp.abs(wHi)), i32(1))
 
     def run(eps_init):
-        y, _z, pm, steps, conv = _transport_loop_tiered(
-            wLo, wHi, R, U, supply, col_cap, eps_init, alpha, num_supersteps,
+        return transport_solve_tiered(
+            wLo, wHi, R, supply, col_cap, eps_init,
+            alpha=alpha, max_supersteps=num_supersteps,
             refine_waves=refine_waves,
         )
-        return y, pm, steps, conv
 
     if eps0 is None:
         return run(eps_full)

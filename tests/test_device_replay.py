@@ -205,6 +205,47 @@ def test_duplicate_submit_skipped_not_leaked():
     assert not h2._live_tasks, "same-window FAIL+resubmit+FINISH leaked"
 
 
+def test_intra_window_interleavings_exact():
+    """The shared window_net_ops automaton must replay intra-window
+    event order exactly — the r4 review's two adversarial shapes:
+    (a) duplicate SUBMIT then FINISH in one window (task must end DEAD:
+    the dup is skipped, the finish retires the original row);
+    (b) SUBMIT, FAIL, re-SUBMIT in one window (task must end LIVE, and
+    a later FINISH must retire it)."""
+    from ksched_tpu.drivers.trace_replay import TraceReplayDriver
+
+    machines = [TraceMachineEvent(0, 0, 0, cpus=4.0)]
+    us = int(1e6)
+
+    # (a) live task; then [dup-SUBMIT, FINISH] in window 2
+    events_a = [
+        TraceTaskEvent(0, 1, 0, SUBMIT),
+        TraceTaskEvent(6 * us, 1, 0, SUBMIT),  # dup while live
+        TraceTaskEvent(7 * us, 1, 0, FINISH),  # retires the ORIGINAL
+    ]
+    # (b) [SUBMIT, FAIL, re-SUBMIT] all in window 1, FINISH later
+    events_b = [
+        TraceTaskEvent(0, 2, 0, SUBMIT),
+        TraceTaskEvent(1 * us, 2, 0, FAIL),
+        TraceTaskEvent(2 * us, 2, 0, SUBMIT),  # legitimate resubmit
+        TraceTaskEvent(9 * us, 2, 0, FINISH),
+    ]
+    for events, n_sub, n_fin in [(events_a, 1, 1), (events_b, 2, 2)]:
+        d = DeviceTraceReplayDriver(
+            machines, slots_per_machine=4, num_jobs_hint=4,
+            task_capacity=16, decode_width=None,
+        )
+        sch = d.stage(events, window_s=5.0)
+        assert (sch["submitted"], sch["finished"]) == (n_sub, n_fin), events
+        st = d.cluster.fetch_stats(d.replay(sch))
+        assert int(st["completed"].sum()) == n_fin
+        assert int(np.asarray(d.cluster.fetch_state()["live"]).sum()) == 0
+        h = TraceReplayDriver(machines, slots_per_machine=4, num_jobs_hint=4)
+        hs = h.replay(events, window_s=5.0)
+        assert (hs.submitted, hs.finished) == (n_sub, n_fin), events
+        assert not h._live_tasks
+
+
 def test_stage_mirror_reuses_freed_rows():
     """A task that finishes frees its row for a later submit — the
     mirror must hand the row out again and completions must target the

@@ -66,6 +66,49 @@ def test_kernel_flow_identical_to_xla_loop(seed, C, M):
     np.testing.assert_array_equal(np.asarray(pm_xla), np.asarray(pm_pl))
 
 
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("C,M", [(2, 5), (4, 40), (6, 130)])
+def test_tiered_kernel_identical_to_xla_tiered_loop(seed, C, M):
+    """The fused TIERED kernel (preemption pricing: residents at
+    wLo = w - discount, the rest at wHi) must match the XLA tiered
+    phase loop bit-for-bit — flows, prices, and superstep counts —
+    with and without price refinement."""
+    from ksched_tpu.ops import transport_loop_pallas_tiered
+    from ksched_tpu.solver.layered import _transport_loop_tiered
+
+    wS, supply, col_cap, n_scale = _random_instance(seed, C, M)
+    rng = np.random.default_rng(seed + 77)
+    discount = int(rng.integers(1, 12)) * n_scale
+    wHi = wS
+    wLo = wS.copy()
+    wLo[:, :M] -= discount
+    # resident census: scattered residents under the cell capacities
+    R = rng.integers(0, 6, (C, wS.shape[1])).astype(np.int32)
+    R[:, -1] = 0
+    eps0 = np.int32(max(1, np.abs(wHi).max()))
+    RJ = jnp.minimum(
+        jnp.asarray(R),
+        jnp.minimum(jnp.asarray(supply)[:, None], jnp.asarray(col_cap)[None, :]),
+    )
+    U = jnp.minimum(jnp.asarray(supply)[:, None], jnp.asarray(col_cap)[None, :])
+    for refine in (0, 8):
+        y_xla, _z, pm_xla, steps_xla, conv_xla = _transport_loop_tiered(
+            jnp.asarray(wLo), jnp.asarray(wHi), RJ, U,
+            jnp.asarray(supply), jnp.asarray(col_cap),
+            jnp.asarray(eps0), 8, 50_000, refine_waves=refine,
+        )
+        y_pl, pm_pl, steps_pl, conv_pl = transport_loop_pallas_tiered(
+            jnp.asarray(wLo), jnp.asarray(wHi), jnp.asarray(R),
+            jnp.asarray(supply), jnp.asarray(col_cap), jnp.asarray(eps0),
+            alpha=8, max_supersteps=50_000, interpret=True,
+            refine_waves=refine,
+        )
+        assert bool(conv_xla) and bool(conv_pl), refine
+        assert int(steps_xla) == int(steps_pl), refine
+        np.testing.assert_array_equal(np.asarray(y_xla), np.asarray(y_pl))
+        np.testing.assert_array_equal(np.asarray(pm_xla), np.asarray(pm_pl))
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_warm_start_stays_exact(seed):
     """Re-solving a perturbed instance from the previous solve's machine

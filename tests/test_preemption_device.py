@@ -332,3 +332,120 @@ def test_device_preemption_accepts_mover_decode_window():
         task_capacity=16, preemption=True, decode_width=4,
     )
     assert dev.decode_width == 4 and dev.preemption
+
+
+# ---------------------------------------------------------------------------
+# stability-aware (incremental) preemption — preempt_every / preempt_drift
+# ---------------------------------------------------------------------------
+
+
+def _hybrid_cluster(every, drift, seed=7, M=40, T=400):
+    from ksched_tpu.costmodels import coco
+    from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
+
+    rng = np.random.default_rng(seed)
+    penalties = rng.integers(0, 40, (M, 4)).astype(np.int64)
+    dev = DeviceBulkCluster(
+        num_machines=M, pus_per_machine=4, slots_per_pu=4, num_jobs=4,
+        num_task_classes=4, task_capacity=1024,
+        class_cost_fn=coco_device_cost_fn(penalties),
+        unsched_cost=coco.UNSCHEDULED_COST, ec_cost=0,
+        supersteps=1 << 16, preemption=True, continuation_discount=8,
+        preempt_every=every, preempt_drift=drift, decode_width=256,
+        track_realized_cost=True,
+    )
+    dev.add_tasks(T, rng.integers(0, 4, T).astype(np.int32),
+                  rng.integers(0, 4, T).astype(np.int32))
+    jax.block_until_ready(dev.round())
+    return dev
+
+
+def test_hybrid_preemption_schedule_and_drift_trigger():
+    """preempt_every=K fires the full tiered re-solve on cadence; the
+    census-drift trigger adds full rounds when placements churn past
+    the threshold; incremental rounds report zero migrations and pin
+    residents (the reference's delta-proportional round property,
+    placement/solver.go:60-90)."""
+    dev = _hybrid_cluster(every=8, drift=0)
+    s = dev.fetch_stats(dev.run_steady_rounds(32, 0.05, 20, seed=3))
+    assert s["converged"].all()
+    full = s["full_round"].astype(bool)
+    # cadence: the fill round() was full and reset the counter, so
+    # the scan's full rounds land every 8th from index 7
+    assert full.sum() == 4
+    assert (np.nonzero(full)[0] == np.array([7, 15, 23, 31])).all()
+    # incremental rounds never migrate or preempt
+    incr = ~full
+    assert (s["migrated"][incr] == 0).all()
+    assert (s["preempted"][incr] == 0).all()
+
+    # occupancy invariant after the mixed scan
+    st = {k: np.asarray(v) for k, v in dev.fetch_state().items()}
+    on = st["live"] & (st["pu"] >= 0)
+    recount = np.bincount(st["pu"][on], minlength=dev.num_pus)
+    assert (recount == st["pu_running"]).all()
+
+    # the drift trigger alone (cadence effectively off) also fires
+    dev2 = _hybrid_cluster(every=1 << 20, drift=60)
+    s2 = dev2.fetch_stats(dev2.run_steady_rounds(32, 0.05, 20, seed=3))
+    full2 = s2["full_round"].astype(bool)
+    assert s2["converged"].all()
+    assert 0 < full2[1:].sum() < 31, "drift trigger should fire sometimes"
+    # every fired round saw drift >= threshold (beyond the forced first)
+    fired = np.nonzero(full2)[0]
+    fired = fired[fired > 0]
+    assert (s2["census_drift"][fired] >= 60).all()
+
+
+def test_hybrid_preemption_objective_drift_bounded():
+    """The stability-aware scheme's realized cluster cost must track
+    the full-re-solve-every-round regime within a small bound — the
+    parity contract for VERDICT r3 #1 (incremental preemption must not
+    silently degrade placement quality)."""
+    # baseline: full solve EVERY round, expressed through the hybrid
+    # wrapper (preempt_every=1 with a token drift threshold) so both
+    # runs report the same realized_cost metric
+    base = _hybrid_cluster(every=1, drift=1 << 30)
+    sb = base.fetch_stats(base.run_steady_rounds(48, 0.05, 20, seed=5))
+    hyb = _hybrid_cluster(every=8, drift=0)
+    sh = hyb.fetch_stats(hyb.run_steady_rounds(48, 0.05, 20, seed=5))
+    assert sb["converged"].all() and sh["converged"].all()
+    rb = sb["realized_cost"].astype(np.float64)
+    rh = sh["realized_cost"].astype(np.float64)
+    # same churn stream (same seed): compare round for round
+    rel = (rh - rb) / np.maximum(rb, 1.0)
+    # bound DEGRADATION only: measured, the hybrid runs consistently
+    # CHEAPER on realized interference cost (pinning residents avoids
+    # the census-feedback thrash of re-migrating every round), so the
+    # negative side is a win, not drift
+    assert rel.mean() < 0.05, f"mean drift {rel.mean():.3f}"
+    assert rel.max() < 0.25, f"max degradation {rel.max():.3f}"
+
+
+def test_hybrid_preemption_checkpoint_roundtrip(tmp_path):
+    """Hybrid-mode checkpoints carry preempt_every/preempt_drift and
+    restored clusters keep scheduling (the drift reference resets at
+    the next one-shot round, which is always full)."""
+    from ksched_tpu.costmodels import coco
+    from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
+    from ksched_tpu.runtime.checkpoint import (
+        load_device_checkpoint,
+        save_device_checkpoint,
+    )
+
+    dev = _hybrid_cluster(every=4, drift=100)
+    dev.fetch_stats(dev.run_steady_rounds(8, 0.05, 10, seed=2))
+    path = str(tmp_path / "hyb.npz")
+    save_device_checkpoint(dev, path)
+
+    rng = np.random.default_rng(7)
+    penalties = rng.integers(0, 40, (40, 4)).astype(np.int64)
+    back = load_device_checkpoint(
+        path, class_cost_fn=coco_device_cost_fn(penalties)
+    )
+    assert back.preempt_every == 4 and back.preempt_drift == 100
+    assert back.hybrid_preempt
+    for k, v in back.fetch_state().items():
+        assert np.array_equal(np.asarray(v), np.asarray(dev.fetch_state()[k])), k
+    s = back.fetch_stats(back.run_steady_rounds(8, 0.05, 10, seed=3))
+    assert s["converged"].all()

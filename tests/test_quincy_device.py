@@ -317,6 +317,50 @@ def test_quincy_device_bounded_window_matches_full():
     assert outs[0] == outs[1]
 
 
+def test_active_cap_ladder_matches_full_width():
+    """The compaction LADDER (a sequence of active_groups_cap widths)
+    must agree with the full-width solve at every rung: rounds whose
+    active-row count fits the smallest width, a middle width, and only
+    the full width all produce identical objectives/placements —
+    compaction is exact, the ladder only changes which static width
+    carries the solve."""
+    M = 4
+    table = QuincyGroupTable(num_groups=16, num_machines=M)
+    for b in range(1, 9):
+        table.blocks.register(b, 512 * MB, [b % M])
+    rng = np.random.default_rng(3)
+
+    outs = []
+    for caps in (16, (2, 6), (1, 4, 12)):
+        dev = DeviceBulkCluster(
+            num_machines=M, pus_per_machine=2, slots_per_pu=2, num_jobs=1,
+            num_task_classes=1, task_capacity=64, num_groups=16,
+            active_groups_cap=caps,
+        )
+        assert dev.active_groups_caps == (
+            (caps,) if isinstance(caps, int) else caps
+        )
+        r = np.random.default_rng(3)
+        # escalating diversity: 1 group, then 3, then 8 — hits the
+        # small rung, a middle rung, and the full-width fallback
+        placed, objs = 0, []
+        for n_groups in (1, 3, 8):
+            blocks = [[int(r.integers(1, n_groups + 1))] for _ in range(6)]
+            groups = table.groups_for(np.zeros(6, np.int32), blocks)
+            table.sync(dev)  # push rows AFTER registration
+            dev.add_tasks(6, groups=groups)
+            s = dev.fetch_stats(dev.round())
+            assert bool(s["converged"])
+            placed += int(s["placed"])
+            objs.append(int(s["objective"]))
+            done = np.nonzero(
+                np.asarray(dev.fetch_state()["live"])
+            )[0]
+            dev.complete_tasks(done.astype(np.int32))
+        outs.append((placed, objs))
+    assert outs[0] == outs[1] == outs[2], outs
+
+
 def test_quincy_device_preemption_mode_with_groups():
     """Preemption + groups: shifting a preference (data re-replicated)
     migrates residents toward the preferred machine."""

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Validate the bench latency model against isolated hardware timings.
+
+bench.py's per-round p99/max numbers come from a calibrated line
+(_round_latency_model: latency = t_fixed + kappa * supersteps) fit on
+chunk walls. Its held-out chunk error is now checked in-band
+(loo_rel_err_* / fit_suspect), but the line's SLOPE — the coefficient
+that converts a superstep tail into a millisecond tail — deserves an
+independent measurement: this tool times captured tail instances
+(tools/tail_repro.py capture) in isolation on hardware and compares
+the measured per-superstep cost against the model's kappa.
+
+Method (the transport's ~110 ms completion-polling floor forbids
+timing one solve — docs/NOTES.md): each captured instance is re-solved
+`reps` times inside ONE jitted lax.scan whose body threads the
+superstep count through the carry (a loop-carried dependency XLA
+cannot hoist), using the SAME solve entry the production round uses
+(solver/layered.py transport_fori / transport_fori_tiered with
+round_core's knobs — alpha, eps0 policy, refinement). Chains are timed
+under the bench discipline (scalar-fetch barrier, >= 2 s walls), and
+(t_loop, kappa) fall out of least squares across instances with
+different superstep counts:
+
+    wall_k = reps_k * t_loop + kappa * total_supersteps_k
+
+kappa_measured vs the suite artifact's per_superstep_us is the
+model-vs-measured comparison VERDICT r3 #3 asked for. The loop's own
+fixed cost (t_loop) is NOT comparable to the round's t_fixed — the
+chain body has no census/decode/apply — so only the slope is compared.
+
+Usage:
+  python tools/tail_repro.py capture --config coco --rounds 60 \
+      --threshold 0 --out /tmp/insts.npz      # 0: keep EVERY round,
+                                              # cheap + tail alike
+  python tools/model_check.py --inst /tmp/insts.npz \
+      --suite-json BENCH_SUITE.jsonl --config coco50k
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _load_model_kappa(suite_json: str, config: str):
+    """per_superstep_us (and the full latency_model) for `config` from
+    a suite artifact written by bench.py --suite."""
+    with open(suite_json) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("config") == config:
+                lm = rec.get("detail", {}).get("latency_model")
+                if lm is None:
+                    raise SystemExit(
+                        f"config {config!r} in {suite_json} has no "
+                        "latency_model (closed-form config?)"
+                    )
+                return lm
+    raise SystemExit(f"config {config!r} not found in {suite_json}")
+
+
+def build_chain(data, k: int, reps: int, alpha: int, supersteps: int):
+    """A jitted `reps`-solve chain of captured instance `k`, matching
+    round_core's solve dispatch (scheduler/device_bulk.py:546-563 for
+    class instances, :852-855 for tiered preemption instances).
+    Returns fn() -> (total_ss, per_rep_ss, all_converged)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ksched_tpu.solver.layered import (
+        choose_eps0,
+        transport_fori,
+        transport_fori_tiered,
+    )
+
+    i32 = jnp.int32
+    n_scale = int(data["n_scale"])
+    Mp = int(data["Mp"])
+    preempt = int(data.get("preempt", 0)) == 1
+    w = data[f"w_{k}"].astype(np.int64)
+    supply = data[f"supply_{k}"].astype(np.int32)
+    col_cap = data[f"colcap_{k}"].astype(np.int32)
+    C, M = w.shape
+    wP = np.zeros((C, Mp), np.int64)
+    wP[:, :M] = w
+    wS = jnp.asarray((wP * n_scale).astype(np.int32))
+    supJ = jnp.asarray(supply)
+    capJ = jnp.asarray(col_cap)
+    eps_full = int(max(1, np.abs(wP).max() * n_scale))
+    free_total = int(col_cap[:M].sum())
+    total = int(supply.sum())
+
+    if preempt:
+        discount = int(data["discount"])
+        R = data[f"residents_{k}"].astype(np.int64)
+        RP = np.zeros((C, Mp), np.int64)
+        RP[:, :M] = R
+        wLoP = wP.copy()
+        wLoP[:, :M] -= discount
+        wLo = jnp.asarray((wLoP * n_scale).astype(np.int32))
+        RJ = jnp.asarray(RP.astype(np.int32))
+        # round_core_preempt: full-unit start (short=n_scale), refine on
+        eps0 = int(choose_eps0(n_scale, eps_full, total, free_total,
+                               short=n_scale))
+
+        def solve(sup_i):
+            return transport_fori_tiered(
+                wLo, wS, RJ, sup_i, capJ, supersteps,
+                alpha=alpha, eps0=eps0, refine_waves=8,
+            )
+    else:
+        # round_core non-grouped: choose_eps0 default short (n_scale/4)
+        eps0 = int(choose_eps0(n_scale, eps_full, total, free_total))
+
+        def solve(sup_i):
+            return transport_fori(
+                wS, sup_i, capJ, supersteps,
+                alpha=alpha, eps0=eps0, refine_waves=8,
+            )
+
+    def chain(_):
+        def body(carry, x):
+            # loop-carried dependency so XLA cannot hoist the
+            # loop-invariant solve out of the scan: x >= 0 always, so
+            # the supply is unchanged at runtime, but the predicate is
+            # dynamic and the carry is loop-carried
+            sup_i = supJ.at[0].add(jnp.where(x < i32(0), carry, i32(0)))
+            y, _pm, steps, conv = solve(sup_i)
+            return carry + steps, (steps, conv)
+
+        total_ss, (ss, conv) = lax.scan(
+            body, i32(0), jnp.arange(reps, dtype=i32)
+        )
+        return total_ss, ss, jnp.all(conv)
+
+    return jax.jit(chain)
+
+
+#: bench.py's floor discipline (see MIN_CHUNK_WALL_MS there)
+MIN_WALL_MS = 2_000.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inst", required=True,
+                    help="captured instances (tools/tail_repro.py capture)")
+    ap.add_argument("--reps", type=int, default=64,
+                    help="initial solves per chain (grown to clear the "
+                    "2 s wall bar on accelerators)")
+    ap.add_argument("--alpha", type=int, default=8)
+    ap.add_argument("--max-instances", type=int, default=8)
+    ap.add_argument("--suite-json", default=None,
+                    help="bench suite artifact to compare kappa against")
+    ap.add_argument("--config", default=None,
+                    help="config name inside --suite-json")
+    ap.add_argument("--supersteps", type=int, default=1 << 17)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    data = np.load(args.inst)
+    n = min(int(data["n"]), args.max_instances)
+    platform = jax.devices()[0].platform
+    min_wall = MIN_WALL_MS if platform != "cpu" else 0.0
+
+    rows = []
+    for k in range(n):
+        reps = args.reps
+        while True:
+            fn = build_chain(data, k, reps, args.alpha, args.supersteps)
+            # warm (compile) + drain with the scalar-fetch barrier
+            out = fn(0)
+            jax.block_until_ready(out)
+            int(jax.device_get(out[0]))
+            t0 = time.perf_counter()
+            out = fn(0)
+            jax.block_until_ready(out)
+            total_ss = int(jax.device_get(out[0]))
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            if wall_ms >= min_wall or reps >= (1 << 18):
+                break
+            grow = max(2, int(np.ceil(2.5 * min_wall / max(wall_ms, 1e-3))))
+            if args.verbose:
+                print(f"# inst {k}: wall {wall_ms:.0f} ms at reps={reps} "
+                      f"under the {min_wall:.0f} ms bar - x{grow}",
+                      file=sys.stderr)
+            reps *= grow
+        ss_per = np.asarray(jax.device_get(out[1]))
+        assert bool(jax.device_get(out[2])), f"instance {k} did not converge"
+        rows.append({
+            "instance": k,
+            "orig_ss": int(data[f"ss_{k}"]),
+            "replay_ss": int(ss_per[0]),
+            "reps": reps,
+            "wall_ms": round(wall_ms, 1),
+            "per_solve_ms": round(wall_ms / reps, 4),
+            "total_ss": total_ss,
+        })
+        if args.verbose:
+            print(f"# inst {k}: replay_ss={ss_per[0]} reps={reps} "
+                  f"wall={wall_ms:.0f} ms -> {wall_ms / reps:.3f} ms/solve",
+                  file=sys.stderr)
+
+    out = {"instances": rows, "platform": platform,
+           "alpha": args.alpha, "inst_file": args.inst}
+    # least squares across chains: wall = reps * t_loop + kappa * ss
+    walls = np.array([r["wall_ms"] for r in rows], np.float64)
+    repss = np.array([r["reps"] for r in rows], np.float64)
+    sss = np.array([r["total_ss"] for r in rows], np.float64)
+    if len(rows) >= 2 and np.ptp(sss / repss) > 0:
+        A = np.stack([repss, sss], axis=1)
+        (t_loop, kappa), *_ = np.linalg.lstsq(A, walls, rcond=None)
+        if kappa < 0 or t_loop < 0:
+            kappa = float(np.sum(walls * sss) / np.sum(sss * sss))
+            t_loop = 0.0
+        out["fit"] = {
+            "t_loop_ms": round(float(t_loop), 4),
+            "kappa_measured_us": round(float(kappa) * 1e3, 4),
+        }
+        if args.suite_json and args.config:
+            lm = _load_model_kappa(args.suite_json, args.config)
+            out["model"] = lm
+            # preempt captures replay the FULL tiered re-solve, so they
+            # validate the mixture model's full-round slope, not the
+            # incremental one
+            if int(data.get("preempt", 0)) and "per_superstep_us_full" in lm:
+                km = lm["per_superstep_us_full"]
+            else:
+                km = lm["per_superstep_us"]
+            out["comparison"] = {
+                "kappa_model_us": km,
+                "kappa_measured_us": out["fit"]["kappa_measured_us"],
+                "measured_over_model": round(
+                    out["fit"]["kappa_measured_us"] / km, 3
+                ) if km else None,
+            }
+    else:
+        out["fit"] = None
+        print("# need >= 2 instances with distinct superstep counts "
+              "for a slope fit", file=sys.stderr)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
