@@ -232,6 +232,7 @@ def save_device_checkpoint(cluster, path: str) -> None:
         "num_groups": cluster.G if cluster.grouped else 0,
         # the full compaction ladder (a JSON list; int in pre-r4 saves)
         "active_groups_cap": list(cluster.active_groups_caps),
+        "two_stage_eps0": cluster.two_stage_eps0,
         "refine_waves": cluster.refine_waves,
         "per_job": int(cluster.per_job),
     }
@@ -302,6 +303,7 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
         num_groups=meta["num_groups"],
         active_groups_cap=meta["active_groups_cap"],
         refine_waves=meta["refine_waves"],
+        two_stage_eps0=meta.get("two_stage_eps0", "one"),
     )
     cluster.state = DeviceClusterState(
         **{name: jnp.asarray(data[f"s_{name}"]) for name in _DEVICE_STATE}

@@ -114,6 +114,7 @@ class DeviceBulkCluster:
         num_groups: int = 0,
         active_groups_cap: int = 256,
         refine_waves: int = 8,
+        two_stage_eps0: str = "one",
     ) -> None:
         self.M = num_machines
         self.P = pus_per_machine
@@ -181,6 +182,17 @@ class DeviceBulkCluster:
         # refine_waves=0 — their cross-backend bit-identity contracts
         # compare superstep-for-superstep.
         self.refine_waves = int(refine_waves)
+        # Stage-1 eps schedule of the grouped two-stage solve — REGIME-
+        # DEPENDENT (docs/NOTES.md): "one" (eps0=1, budget 256) wins on
+        # near-uniform discounts (single-block Quincy: tens of waves
+        # when pref capacity suffices); "quarter" (n_scale/4, budget
+        # 1024) wins on heavy-tailed discounts (multi-block: captured
+        # tail rounds 3580 -> 51 supersteps — the eps=1 schedule pays
+        # for ~190-unit discount descents in unit bounces, r4 sweep
+        # via tools/tail_repro.py replay-grouped).
+        if two_stage_eps0 not in ("one", "quarter"):
+            raise ValueError("two_stage_eps0 must be 'one' or 'quarter'")
+        self.two_stage_eps0 = two_stage_eps0
         # Preemption (keep-arcs semantics, graph_manager.go:855-888):
         # every round's solve reconsiders PLACED tasks too — staying on
         # the current machine is discounted by `continuation_discount`
@@ -341,6 +353,7 @@ class DeviceBulkCluster:
         class_degenerate = self.class_degenerate
         row_constant = self.row_constant
         preempt, discount = self.preemption, self.continuation_discount
+        stage1_quarter = self.two_stage_eps0 == "quarter"
         hybrid = self.hybrid_preempt
         preempt_every = self.preempt_every
         preempt_drift = self.preempt_drift
@@ -678,19 +691,26 @@ class DeviceBulkCluster:
                         return solve_full(None)
 
                     def solve_two_stage(_):
-                        # eps0=1 finishes the sparse matching in tens
-                        # of waves when pref capacity suffices, but
-                        # stalls on deep descents when residents block
-                        # the preferred machines — bound it HONESTLY
+                        # Stage-1 schedule per two_stage_eps0 (see
+                        # __init__): "one" finishes the sparse matching
+                        # in tens of waves when discounts are near-
+                        # uniform but pays deep descents in unit
+                        # bounces on heavy-tailed discounts; "quarter"
+                        # flips that trade. Bounded HONESTLY either way
                         # (eps0_retry=False: no internal full-range
-                        # retry on the discount matrix, which the tail
-                        # study measured at 3.2-11.7k supersteps on
-                        # blocked rounds) and fall back to the refined
-                        # full solve of the ORIGINAL matrix (~1-3.3k).
+                        # retry on the discount matrix) with the
+                        # refined full solve of the ORIGINAL matrix as
+                        # the fallback.
+                        if stage1_quarter:
+                            s1_eps0 = jnp.maximum(i32(1), i32(n_scale // 4))
+                            s1_budget = 1024
+                        else:
+                            s1_eps0 = i32(1)
+                            s1_budget = 256
                         y1, _pm1, s1, conv1 = transport_fori(
                             wS1_x, supply_x, col_cap, supersteps,
                             alpha=2, refine_waves=8,
-                            eps0=i32(1), eps0_budget=256,
+                            eps0=s1_eps0, eps0_budget=s1_budget,
                             eps0_retry=False,
                         )
 

@@ -1,0 +1,582 @@
+"""Generic FlowProblem -> dense-transport collapse with automatic CSR
+fallback: the policy-dispatch seam of docs/solver_coverage.md, encoded.
+
+The reference serves every policy through one solver seam
+(scheduling/flow/placement/solver.go:36-38). The rebuild's production
+path is the dense layered transport — exact whenever the graph is
+"dense-collapsible" (no binding interior-EC capacities, cost-uniform
+resource interiors, no per-task leaf arcs; docs/solver_coverage.md) —
+with the CSR backends as the total-generality fallback. Until round 4
+the CALLER chose the path; this module encodes the losslessness
+predicate so the choice is automatic per solve:
+
+    AutoSolver(csr_backend).solve(problem)
+      -> try_collapse(problem): a full structural audit of the flat
+         arc arrays. Collapsible -> group tasks into signature rows,
+         solve ONE dense transport, reconstruct exact per-arc flows.
+         Any refusal (with a reason, kept for observability) -> the
+         CSR backend, unchanged semantics.
+
+Soundness: every refusal is conservative (routing to CSR can only cost
+time, never correctness), and the collapse itself is exact by the
+signature argument of docs/solver_coverage.md — tasks with identical
+(escape cost, effective machine-cost row) are interchangeable
+commodities, and interior resource trees with a unique path cost fold
+into per-column constants + tree capacities (computed as the exact
+tree max-flow). Reconstructed flows satisfy conservation and caps by
+construction; tests assert objective equality against the CSR oracle.
+
+Collapsible today (the entire non-preempt planned-policy surface):
+tasks -> {job unsched aggregator | equivalence classes | machines},
+EC -> EC chains that cannot bind, EC -> machine routes, machine
+subtrees with a unique per-machine path cost to the sink. Pinned
+running tasks (preemption-off) arrive lower-bound-folded and cost
+nothing. Keep-mode (preemption-on) graphs carry per-task running arcs
+to leaves -> refused -> CSR, as are binding interior capacities and
+any structure outside the audited shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graph.flowgraph import NodeType
+from .base import FlowResult, FlowSolver, lower_bound_cost
+
+_TASK_TYPES = (
+    int(NodeType.ROOT_TASK),
+    int(NodeType.SCHEDULED_TASK),
+    int(NodeType.UNSCHEDULED_TASK),
+)
+_BELOW_MACHINE = (
+    int(NodeType.NUMA),
+    int(NodeType.SOCKET),
+    int(NodeType.CACHE),
+    int(NodeType.CORE),
+    int(NodeType.PU),
+)
+
+
+@dataclass
+class _MachineTree:
+    """One machine column: exact tree capacity, the unique path cost
+    machine->sink, and the arc lists needed to push decoded units."""
+
+    node: int
+    capacity: int
+    path_cost: int
+    # (arc_idx, child_node) per node, in arc order; child == -1 -> sink
+    children: Dict[int, List[Tuple[int, int]]]
+
+
+@dataclass
+class GraphCollapse:
+    """Everything needed to solve the dense form and reconstruct."""
+
+    supply: np.ndarray  # int32[G]
+    col_cap: np.ndarray  # int32[M]
+    cost_cm: np.ndarray  # int32[G, M] full placement cost per unit
+    row_unsched: np.ndarray  # int64[G] full escape cost per unit
+    machines: List[_MachineTree]
+    pre_flows: List[Tuple[int, int]]  # folded pinned units (arc, units)
+    rows_tasks: List[List[int]]  # task node ids per row
+    # per task: route realization per machine column:
+    #   ("d", arc) direct | ("e", t_ec_arc, (chain arcs...), ec_m_arc)
+    task_routes: List[Dict[int, tuple]]
+    task_escape: List[Tuple[int, int]]  # (task->agg arc, agg->sink arc)
+
+
+def _refuse(reason: str):
+    return None, reason
+
+
+def try_collapse(problem) -> Tuple[Optional[GraphCollapse], str]:
+    """Audit a FlowProblem against the dense-collapsibility predicate.
+
+    Returns (collapse, "") when lossless, (None, reason) otherwise.
+    Pure host-side numpy over the flat arrays; O(nodes + arcs + G*M).
+    """
+    nt = np.asarray(problem.node_type)
+    excess = np.asarray(problem.excess)
+    src = np.asarray(problem.src)
+    dst = np.asarray(problem.dst)
+    cap = np.asarray(problem.cap)
+    cost = np.asarray(problem.cost)
+
+    live = np.nonzero((src > 0) & (cap > 0))[0]
+    sinks = np.nonzero(nt == int(NodeType.SINK))[0]
+    if len(sinks) != 1:
+        return _refuse(f"{len(sinks)} sink nodes")
+    sink = int(sinks[0])
+
+    out: Dict[int, List[int]] = {}
+    for a in live:
+        out.setdefault(int(src[a]), []).append(int(a))
+
+    # Positive excess: task nodes (one row unit each) or resource
+    # nodes — the latter are lower-bound-FOLDED pinned running tasks
+    # (preemption-off pins with cap_lower=1, graph_manager.go:675-720).
+    # Folded units stay stranded at their resource (the CSR backends
+    # leave them exactly so: the occupied slot's residual sink cap is
+    # already 0, and the decode reads the pin from the arc's
+    # flow_offset); the collapse ignores them the same way. Any other
+    # excess pattern is outside the audited shape.
+    _RESOURCE_TYPES = (int(NodeType.MACHINE),) + _BELOW_MACHINE
+    pos = np.nonzero(excess > 0)[0]
+    if not np.isin(nt[pos], _TASK_TYPES + _RESOURCE_TYPES).all():
+        return _refuse("positive excess off tasks/resources")
+    neg = np.nonzero(excess < 0)[0]
+    if len(neg) > 1 or (len(neg) == 1 and int(neg[0]) != sink):
+        return _refuse("negative excess off the sink")
+    task_mask = np.isin(nt, _TASK_TYPES)
+    total_supply = int(excess[(excess > 0) & task_mask].sum())
+
+    # ---- machine subtrees: unique path cost + exact tree capacity ----
+    machine_nodes = np.nonzero(nt == int(NodeType.MACHINE))[0]
+    col_of: Dict[int, int] = {}
+    machines: List[_MachineTree] = []
+    claimed: Dict[int, int] = {}  # below-machine node -> owning machine
+
+    # ---- folded pinned units: route each resource node's positive
+    # excess to the sink FIRST (the pinned task occupies its slot; the
+    # occupancy-reduced interior caps — graph_manager.go:662-667 — mean
+    # the unit typically has exactly its own leaf->sink hop left).
+    # Machine capacities below are computed on the remaining caps. ----
+    pre_flows: List[Tuple[int, int]] = []
+    cap_res = cap.astype(np.int64).copy()
+    _ROUTABLE = _BELOW_MACHINE + (int(NodeType.MACHINE),)
+
+    def _route(v: int, units: int) -> int:
+        routed = 0
+        for a in out.get(v, []):
+            if units == 0:
+                break
+            d = int(dst[a])
+            if d == sink:
+                take = min(units, int(cap_res[a]))
+            elif int(nt[d]) in _ROUTABLE:
+                take = _route(d, min(units, int(cap_res[a])))
+            else:
+                continue
+            if take:
+                cap_res[a] -= take
+                pre_flows.append((int(a), take))
+                units -= take
+                routed += take
+        return routed
+
+    for v in pos:
+        v = int(v)
+        if int(nt[v]) in _ROUTABLE:
+            e = int(excess[v])
+            if _route(v, e) != e:
+                return _refuse(
+                    f"resource {v}: folded pinned units exceed capacity"
+                )
+
+    for m in machine_nodes:
+        m = int(m)
+        children: Dict[int, List[Tuple[int, int]]] = {}
+        path_cost: Optional[int] = None
+        ok = True
+
+        def walk(v: int, acc: int) -> int:
+            """Returns remaining capacity-to-sink of v; records the
+            children arcs; checks the unique-path-cost condition."""
+            nonlocal path_cost, ok
+            total_cap = 0
+            kids: List[Tuple[int, int]] = []
+            for a in out.get(v, []):
+                d = int(dst[a])
+                if d == sink:
+                    c = acc + int(cost[a])
+                    if path_cost is None:
+                        path_cost = c
+                    elif path_cost != c:
+                        ok = False
+                    kids.append((a, -1))
+                    total_cap += int(cap_res[a])
+                elif int(nt[d]) in _BELOW_MACHINE:
+                    if d in claimed:
+                        # reached twice — from another machine OR from
+                        # this one (diamond/cycle): either way not a
+                        # tree; refuse rather than double-count
+                        ok = False
+                        continue
+                    claimed[d] = m
+                    sub = walk(d, acc + int(cost[a]))
+                    kids.append((a, d))
+                    total_cap += min(int(cap_res[a]), sub)
+                else:
+                    ok = False  # machine interior reaching a non-resource
+            children[v] = kids
+            return total_cap
+
+        capacity = walk(m, 0)
+        if not ok:
+            return _refuse(f"machine {m}: non-uniform or non-tree interior")
+        if path_cost is None:
+            capacity, path_cost = 0, 0  # no route to sink: dead column
+        col_of[m] = len(machines)
+        machines.append(_MachineTree(
+            node=m, capacity=capacity, path_cost=path_cost,
+            children=children,
+        ))
+    if not machines:
+        return _refuse("no machine nodes")
+    M = len(machines)
+
+    # ---- EC routing (chains folded; caps must never bind) ----
+    ec_nodes = [int(e) for e in np.nonzero(nt == int(NodeType.EQUIV_CLASS))[0]]
+    # upper bound on flow through an EC: tasks with an arc into it,
+    # PLUS everything its upstream ECs could forward (a chain-fed EC
+    # sees the whole upstream inflow — counting only direct task arcs
+    # would understate the bound to 0 and wave binding caps through)
+    ec_direct: Dict[int, int] = {e: 0 for e in ec_nodes}
+    ec_parents: Dict[int, List[int]] = {e: [] for e in ec_nodes}
+    task_ids = [
+        int(t) for t in np.nonzero(
+            np.isin(nt, _TASK_TYPES) & (excess > 0)
+        )[0]
+    ]
+    for t in task_ids:
+        for a in out.get(t, []):
+            d = int(dst[a])
+            if int(nt[d]) == int(NodeType.EQUIV_CLASS):
+                ec_direct[d] = ec_direct.get(d, 0) + 1
+    for e in ec_nodes:
+        for a in out.get(e, []):
+            d = int(dst[a])
+            if int(nt[d]) == int(NodeType.EQUIV_CLASS) and d in ec_parents:
+                ec_parents[d].append(e)
+
+    ec_inflow: Dict[int, object] = {}
+    _PENDING = object()
+
+    def inflow_of(e: int) -> int:
+        got = ec_inflow.get(e)
+        if got is _PENDING:
+            raise ValueError("EC cycle")
+        if got is not None:
+            return got
+        ec_inflow[e] = _PENDING
+        total = ec_direct.get(e, 0) + sum(
+            inflow_of(p) for p in ec_parents.get(e, [])
+        )
+        ec_inflow[e] = total
+        return total
+
+    try:
+        for e in ec_nodes:
+            inflow_of(e)
+    except ValueError as err:
+        return _refuse(str(err))
+
+    # ec_route[e] = {col: (cost, path arcs...)} cheapest route to each
+    # machine column through EC->EC chains (memoized DFS, cycle check)
+    _IN_PROGRESS = object()
+    ec_route: Dict[int, object] = {}
+
+    def route_of(e: int):
+        got = ec_route.get(e)
+        if got is _IN_PROGRESS:
+            raise ValueError("EC cycle")
+        if got is not None:
+            return got
+        ec_route[e] = _IN_PROGRESS
+        routes: Dict[int, Tuple[int, tuple]] = {}
+        for a in out.get(e, []):
+            d = int(dst[a])
+            td = int(nt[d])
+            if td == int(NodeType.MACHINE):
+                # the arc can only bind if it could carry less than
+                # both the feeding tasks AND the machine's own column
+                # capacity (which already limits total inflow)
+                bound = min(
+                    int(ec_inflow.get(e, 0)), total_supply,
+                    machines[col_of[d]].capacity,
+                )
+                if int(cap[a]) < bound:
+                    raise ValueError(
+                        f"EC {e}: machine arc cap {int(cap[a])} can bind"
+                    )
+                c = int(cost[a])
+                col = col_of[d]
+                if col not in routes or c < routes[col][0]:
+                    routes[col] = (c, (a,))
+            elif td == int(NodeType.EQUIV_CLASS):
+                if int(cap[a]) < min(int(ec_inflow.get(e, 0)), total_supply):
+                    raise ValueError(
+                        f"EC {e}: interior EC arc cap {int(cap[a])} can bind"
+                    )
+                for col, (c2, arcs2) in route_of(d).items():
+                    c = int(cost[a]) + c2
+                    if col not in routes or c < routes[col][0]:
+                        routes[col] = (c, (a,) + arcs2)
+            else:
+                raise ValueError(f"EC {e} arcs to node type {td}")
+        ec_route[e] = routes
+        return routes
+
+    try:
+        for e in ec_nodes:
+            route_of(e)
+    except ValueError as err:
+        return _refuse(str(err))
+
+    # ---- unsched aggregators (lookup over RAW arcs: a fully-drained
+    # agg's sink arc has cap 0 and is absent from the live set; it only
+    # matters if some task still routes to it — the escape-capacity
+    # check below catches that) ----
+    agg_sink_arc: Dict[int, int] = {}
+    agg_load: Dict[int, int] = {}
+    agg_mask = nt[src] == int(NodeType.JOB_AGGREGATOR)
+    for a in np.nonzero((src > 0) & agg_mask)[0]:
+        g = int(src[a])
+        if int(dst[a]) != sink:
+            return _refuse(f"unsched agg {g}: non-sink arc")
+        if g in agg_sink_arc:
+            return _refuse(f"unsched agg {g}: multiple sink arcs")
+        agg_sink_arc[g] = int(a)
+
+    # ---- tasks -> signature rows ----
+    BIG = 1 << 26  # disallowed-cell cost; escape is always cheaper
+    sig_to_row: Dict[bytes, int] = {}
+    rows_tasks: List[List[int]] = []
+    row_cost: List[np.ndarray] = []
+    row_u: List[int] = []
+    task_routes: List[Dict[int, tuple]] = []
+    task_escape: List[Tuple[int, int]] = []
+    col_base = np.array([mt.path_cost for mt in machines], np.int64)
+
+    for t in task_ids:
+        if int(excess[t]) != 1:
+            return _refuse(f"task {t}: excess {int(excess[t])} != 1")
+        crow = np.full(M, BIG, np.int64)
+        routes: Dict[int, tuple] = {}
+        esc: Optional[Tuple[int, int]] = None
+        for a in out.get(t, []):
+            d = int(dst[a])
+            td = int(nt[d])
+            if td == int(NodeType.JOB_AGGREGATOR):
+                if esc is not None:
+                    return _refuse(f"task {t}: two escape arcs")
+                if d not in agg_sink_arc:
+                    return _refuse(f"task {t}: escape agg {d} has no sink arc")
+                esc = (int(a), agg_sink_arc[d])
+            elif td == int(NodeType.MACHINE):
+                col = col_of[d]
+                c = int(cost[a])
+                if c < crow[col]:
+                    crow[col] = c
+                    routes[col] = ("d", int(a))
+            elif td == int(NodeType.EQUIV_CLASS):
+                for col, (c2, arcs2) in ec_route[d].items():
+                    c = int(cost[a]) + c2
+                    if c < crow[col]:
+                        crow[col] = c
+                        routes[col] = ("e", int(a)) + tuple(arcs2)
+            else:
+                return _refuse(
+                    f"task {t}: arc to node type {td} (leaf/keep-mode?)"
+                )
+        if esc is None:
+            return _refuse(f"task {t}: no unsched-aggregator arc")
+        u_eff = int(cost[esc[0]]) + int(cost[esc[1]])
+        agg_load[int(dst[esc[0]])] = agg_load.get(int(dst[esc[0]]), 0) + 1
+        crow = crow + col_base
+        key = crow.tobytes() + u_eff.to_bytes(8, "little", signed=True)
+        r = sig_to_row.get(key)
+        if r is None:
+            r = len(rows_tasks)
+            sig_to_row[key] = r
+            rows_tasks.append([])
+            row_cost.append(crow)
+            row_u.append(u_eff)
+        rows_tasks[r].append(t)
+        task_routes.append(routes)
+        task_escape.append(esc)
+
+    # escape capacity must not bind (cap >= tasks that may take it)
+    for g, load in agg_load.items():
+        if int(cap[agg_sink_arc[g]]) < load:
+            return _refuse(
+                f"unsched agg {g}: sink cap {int(cap[agg_sink_arc[g]])} "
+                f"< {load} tasks (binding escape)"
+            )
+
+    # disallowed cells: any finite value strictly above every escape
+    # cost (escape capacity is unbounded, so such a cell is never
+    # taken); keeping it small avoids int32 overflow under the
+    # solver's internal n_scale cost scaling
+    if rows_tasks:
+        cost_mat = np.stack(row_cost)
+        finite = cost_mat[cost_mat < BIG]
+        hi = int(finite.max()) if finite.size else 0
+        disallowed = max(hi, int(max(row_u))) + 1
+        cost_mat = np.where(cost_mat >= BIG, disallowed, cost_mat)
+        row_cost = list(cost_mat)
+
+    # task_routes/task_escape are parallel to task_ids order; the
+    # reconstructor re-keys them per task node id via the escape arc
+    return GraphCollapse(
+        supply=np.array([len(r) for r in rows_tasks], np.int32),
+        col_cap=np.array([mt.capacity for mt in machines], np.int32),
+        cost_cm=(
+            np.stack(row_cost).astype(np.int64)
+            if rows_tasks else np.zeros((0, M), np.int64)
+        ),
+        row_unsched=np.array(row_u, np.int64),
+        machines=machines,
+        pre_flows=pre_flows,
+        rows_tasks=rows_tasks,
+        task_routes=task_routes,
+        task_escape=task_escape,
+    ), ""
+
+
+class AutoSolver(FlowSolver):
+    """The automatic policy-dispatch seam: dense transport when the
+    graph is collapsible, the CSR backend otherwise. Drop-in FlowSolver
+    (PlacementSolver/FlowScheduler-compatible); `last_path` /
+    `last_refusal` expose which way each solve went."""
+
+    def __init__(self, csr_backend: FlowSolver,
+                 alpha: int = 8, max_supersteps: int = 1 << 17):
+        self.csr = csr_backend
+        self.alpha = alpha
+        self.max_supersteps = max_supersteps
+        self.last_path = ""
+        self.last_refusal = ""
+        self.last_supersteps = 0
+
+    def reset(self) -> None:
+        self.csr.reset()
+
+    def solve(self, problem) -> FlowResult:
+        collapse, reason = try_collapse(problem)
+        if collapse is None:
+            self.last_path, self.last_refusal = "csr", reason
+            res = self.csr.solve(problem)
+            self.last_supersteps = getattr(
+                self.csr, "last_supersteps", None
+            ) or getattr(self.csr, "last_iterations", 0)
+            return res
+        self.last_path, self.last_refusal = "dense", ""
+        return self._solve_dense(problem, collapse)
+
+    def _solve_dense(self, problem, gc: GraphCollapse) -> FlowResult:
+        from .layered import LayeredProblem, LayeredTransportSolver
+
+        if not gc.rows_tasks:
+            # nothing unplaced: only the folded pins' continuation flow
+            flow = np.zeros(len(problem.src), np.int64)
+            for a, units in gc.pre_flows:
+                flow[a] += units
+            self.last_supersteps = 0
+            return FlowResult(
+                flow=flow,
+                objective=int(
+                    (flow * np.asarray(problem.cost, np.int64)).sum()
+                ) + lower_bound_cost(problem),
+                iterations=0,
+            )
+        solver = LayeredTransportSolver(
+            alpha=self.alpha, max_supersteps=self.max_supersteps
+        )
+        res = solver.solve_layered(LayeredProblem(
+            supply=gc.supply,
+            col_cap=gc.col_cap,
+            cost_cm=gc.cost_cm.astype(np.int32),
+            unsched_cost=0,
+            ec_cost=0,
+            row_unsched_cost=gc.row_unsched,
+        ))
+        self.last_supersteps = res.supersteps
+        y = np.asarray(res.y, np.int64)
+
+        # ---- exact per-arc flow reconstruction ----
+        flow = np.zeros(len(problem.src), np.int64)
+        # folded pinned units first: they consumed tree capacity at
+        # audit time, so the greedy pushes below see the same residuals
+        for a, units in gc.pre_flows:
+            flow[a] += units
+        # per-task lookups, keyed by node id via each escape arc's src
+        esc_by_task: Dict[int, Tuple[int, int]] = {}
+        routes_by_task: Dict[int, Dict[int, tuple]] = {}
+        src = np.asarray(problem.src)
+        for routes, esc in zip(gc.task_routes, gc.task_escape):
+            t = int(src[esc[0]])
+            esc_by_task[t] = esc
+            routes_by_task[t] = routes
+
+        def tree_cap(mt: _MachineTree, v: int) -> int:
+            total = 0
+            for a, child in mt.children.get(v, []):
+                if child == -1:
+                    total += int(problem.cap[a]) - int(flow[a])
+                else:
+                    total += min(
+                        int(problem.cap[a]) - int(flow[a]),
+                        tree_cap(mt, child),
+                    )
+            return total
+
+        def push_down(mt: _MachineTree, v: int, units: int) -> None:
+            """Distribute `units` down the machine tree (greedy against
+            residual throughput; any split is optimal — path costs are
+            uniform by audit)."""
+            for a, child in mt.children.get(v, []):
+                if units == 0:
+                    return
+                if child == -1:
+                    room = int(problem.cap[a]) - int(flow[a])
+                    take = min(units, room)
+                    flow[a] += take
+                    units -= take
+                else:
+                    room = min(
+                        int(problem.cap[a]) - int(flow[a]),
+                        tree_cap(mt, child),
+                    )
+                    take = min(units, room)
+                    if take > 0:
+                        push_down(mt, child, take)
+                        flow[a] += take
+                        units -= take
+            assert units == 0, "tree capacity audit violated"
+
+        for g, tasks in enumerate(gc.rows_tasks):
+            grants = y[g]
+            ti = 0
+            for col in np.nonzero(grants > 0)[0]:
+                n = int(grants[col])
+                mt = gc.machines[col]
+                for _ in range(n):
+                    t = tasks[ti]
+                    ti += 1
+                    route = routes_by_task[t].get(int(col))
+                    assert route is not None, (
+                        "solver granted a disallowed cell — cost "
+                        "dominance audit violated"
+                    )
+                    if route[0] == "d":
+                        flow[route[1]] += 1
+                    else:
+                        for a in route[1:]:
+                            flow[a] += 1
+                push_down(mt, mt.node, n)
+            for t in tasks[ti:]:  # escapes
+                a1, a2 = esc_by_task[t]
+                flow[a1] += 1
+                flow[a2] += 1
+
+        objective = int(
+            (flow * np.asarray(problem.cost, np.int64)).sum()
+        ) + lower_bound_cost(problem)
+        return FlowResult(
+            flow=flow, objective=objective, iterations=int(res.supersteps)
+        )

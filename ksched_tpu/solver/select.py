@@ -38,4 +38,15 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         from .layered import LayeredTransportSolver
 
         return LayeredTransportSolver()
-    raise ValueError(f"unknown backend {name!r}; want native | jax | ref | layered")
+    if name == "auto":
+        # the policy-dispatch seam (docs/solver_coverage.md): dense
+        # transport whenever the graph audits as collapsible, the CSR
+        # backend otherwise — per solve, automatically
+        from .graph_collapse import AutoSolver
+
+        return AutoSolver(
+            make_backend("native", warm_start=warm_start, fallback=fallback)
+        )
+    raise ValueError(
+        f"unknown backend {name!r}; want native | jax | ref | layered | auto"
+    )

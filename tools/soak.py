@@ -6,12 +6,22 @@ drift (pu_running vs actual placements), convergence decay as the class
 mix wanders, and accounting leaks across enable/disable cycles.
 
 Usage: python tools/soak.py [--rounds 4096] [--tasks 20000] [--cpu]
+       python tools/soak.py --preempt --checkpoint-every 4
 Exit code 0 = all checkpoints clean.
+
+--preempt runs the soak in stability-aware preemption mode (hybrid
+incremental + full tiered re-solves, the coco50k-preempt regime).
+--checkpoint-every N additionally round-trips the cluster through
+save/load_device_checkpoint every N chunks MID-SOAK — the restored
+cluster must be bit-identical and the soak continues on it (restart
+under churn at scale, not the unit test's toy shape; SURVEY §5
+"device-side graph state reconstructible at any time").
 """
 
 import argparse
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -26,6 +36,11 @@ def main() -> int:
     ap.add_argument("--machines", type=int, default=500)
     ap.add_argument("--chunk", type=int, default=256)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--preempt", action="store_true",
+                    help="stability-aware preemption mode (hybrid rounds)")
+    ap.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                    help="save+load+verify a device checkpoint every N "
+                    "chunks and continue on the RESTORED cluster")
     args = ap.parse_args()
 
     if args.cpu:
@@ -43,6 +58,15 @@ def main() -> int:
 
     rng = np.random.default_rng(0)
     pen = rng.integers(0, 40, (args.machines, 4)).astype(np.int64)
+    cost_fn = coco_device_cost_fn(pen)
+    preempt_kw = {}
+    if args.preempt:
+        preempt_kw = dict(
+            preemption=True,
+            continuation_discount=8,
+            preempt_every=16,
+            preempt_drift=max(100, args.tasks // 5),
+        )
     dev = DeviceBulkCluster(
         num_machines=args.machines,
         pus_per_machine=4,
@@ -50,11 +74,12 @@ def main() -> int:
         num_jobs=16,
         num_task_classes=4,
         task_capacity=next_pow2(args.tasks + 4096),
-        class_cost_fn=coco_device_cost_fn(pen),
+        class_cost_fn=cost_fn,
         supersteps=1 << 17,
         unsched_cost=2500,
         ec_cost=0,
         decode_width=2048,
+        **preempt_kw,
     )
     dev.add_tasks(
         args.tasks,
@@ -105,14 +130,44 @@ def main() -> int:
             np.clip(pu, 0, dev.num_pus - 1)
         ]
         assert not on_disabled.any(), f"task on disabled machine at {rounds_done}"
+        extra = ""
+        if args.preempt and "full_round" in got:
+            extra = (
+                f" full={int(got['full_round'].sum())}"
+                f" migrated={int(got['migrated'].sum())}"
+                f" preempted={int(got['preempted'].sum())}"
+            )
         print(
             f"round {rounds_done:6d}: live={int(got['live'][-1])} "
             f"placed/round={got['placed'].mean():.1f} "
             f"supersteps mean={got['supersteps'].mean():.0f} "
             f"max={int(got['supersteps'].max())} "
-            f"down={len(down)}",
+            f"down={len(down)}" + extra,
             flush=True,
         )
+
+        # ---- mid-soak checkpoint round-trip: the soak CONTINUES on
+        # the restored cluster, so any reconstruction defect surfaces
+        # as invariant drift in later chunks ----
+        if args.checkpoint_every and chunk_i % args.checkpoint_every == 0:
+            from ksched_tpu.runtime.checkpoint import (
+                load_device_checkpoint,
+                save_device_checkpoint,
+            )
+
+            with tempfile.TemporaryDirectory() as td:
+                path = os.path.join(td, "soak.npz")
+                save_device_checkpoint(dev, path)
+                restored = load_device_checkpoint(path, class_cost_fn=cost_fn)
+            before = dev.fetch_state()
+            after = restored.fetch_state()
+            for k in before:
+                assert np.array_equal(
+                    np.asarray(before[k]), np.asarray(after[k])
+                ), f"checkpoint round-trip drift in {k} at round {rounds_done}"
+            dev = restored
+            print(f"round {rounds_done:6d}: checkpoint round-trip OK "
+                  "(soak continues on the restored cluster)", flush=True)
 
     dt = time.perf_counter() - t_start
     print(

@@ -104,6 +104,51 @@ def build_config(name: str):
         )
         table.sync(dev)
         dev._tail_repro_groups = (table, groups)  # capture() hooks
+    elif name == "multiblock":
+        # bench.py _quincy_multiblock_bench's exact setup (split quanta,
+        # heavy-tailed block sizes, skewed template pool) so captured
+        # tails are THAT config's tails
+        from ksched_tpu.costmodels.quincy_device import QuincyGroupTable
+
+        MBv = 1 << 20
+        tasks, machines, n_blocks, G = 10_000, 1_000, 480, 1024
+        n_templates = 640
+        dev = DeviceBulkCluster(
+            num_machines=machines, pus_per_machine=4, slots_per_pu=4,
+            num_jobs=10, task_capacity=next_pow2(tasks + 4096),
+            num_groups=G, supersteps=1 << 17, decode_width=2048,
+            active_groups_cap=(128, 256, 512),
+            two_stage_eps0="quarter",
+        )
+        table = QuincyGroupTable(
+            num_groups=G, num_machines=machines,
+            cost_unit_mb=64, sig_unit_mb=128,
+        )
+        rng7 = np.random.default_rng(7)
+        sizes = (
+            128 * MBv * np.exp(rng7.exponential(1.2, n_blocks))
+        ).astype(np.int64)
+        sizes = np.minimum(sizes, 4096 * MBv)
+        for b in range(1, n_blocks + 1):
+            table.blocks.register(
+                b, int(sizes[b - 1]),
+                rng7.choice(machines, size=3, replace=False).tolist(),
+            )
+        templates = [
+            sorted(
+                rng7.choice(n_blocks, size=int(rng7.integers(2, 4)),
+                            replace=False) + 1
+            )
+            for _ in range(n_templates)
+        ]
+        popularity = 1.0 / np.arange(1, n_templates + 1) ** 0.8
+        popularity /= popularity.sum()
+        t_idx = rng7.choice(n_templates, size=tasks, p=popularity)
+        groups = table.groups_for(
+            np.zeros(tasks, np.int32), [templates[t] for t in t_idx]
+        )
+        table.sync(dev)
+        dev._tail_repro_groups = (table, groups)
     else:
         raise SystemExit(f"unknown config {name!r}")
     return dev, tasks
@@ -549,7 +594,7 @@ def main():
     cap = sub.add_parser("capture")
     cap.add_argument(
         "--config", default="whare",
-        choices=["whare", "coco", "quincy", "coco-preempt"],
+        choices=["whare", "coco", "quincy", "multiblock", "coco-preempt"],
     )
     cap.add_argument("--rounds", type=int, default=200)
     cap.add_argument("--warmup", type=int, default=0)
