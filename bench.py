@@ -1093,6 +1093,7 @@ def _gtrace_device_bench(verbose: bool = False, burst: bool = False) -> dict:
         wall, _ = timed_chunk(i0, K, seed=1)  # recompile at the new K
         i0 += K
     chunk_walls, chunk_stats = [], []
+    timed_lo = i0
     while len(chunk_walls) < chunks_wanted and i0 + K <= total:
         wall, stats = timed_chunk(i0, K, seed=2 + len(chunk_walls))
         i0 += K
@@ -1104,6 +1105,10 @@ def _gtrace_device_bench(verbose: bool = False, burst: bool = False) -> dict:
             )
         chunk_walls.append(round(wall, 1))
         chunk_stats.append(stats)
+    # burst-coverage evidence: admission-batch stats of the TIMED
+    # window range (a burst claim is only as good as the spikes the
+    # clock actually saw)
+    adm_timed = sch["adm_n"][timed_lo:i0]
     if len(chunk_walls) < 2:
         raise RuntimeError("not enough staged windows for 2 measured chunks")
 
@@ -1126,6 +1131,8 @@ def _gtrace_device_bench(verbose: bool = False, burst: bool = False) -> dict:
         "finished": sch["finished"],
         "evicted_measured": evicted,
         "placed_measured": placed,
+        "adm_per_window_timed_p50": int(np.percentile(adm_timed, 50)),
+        "adm_per_window_timed_max": int(adm_timed.max()),
         "supersteps_max": int(np.concatenate(ss_all).max()),
         "latency_model": _round_latency_model(
             np.array(chunk_walls), K, ss_all

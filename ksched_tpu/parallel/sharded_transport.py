@@ -43,6 +43,7 @@ from ..solver.layered import (
     pad_geometry,
     solve_layered_host,
     transport_saturate,
+    transport_saturate_tiered,
     validate_alpha,
 )
 
@@ -255,3 +256,260 @@ class ShardedLayeredSolver:
             raise
         self.last_supersteps = res.supersteps
         return res
+
+
+def _sharded_transport_tiered_fn(wLo, wHi, R, supply, col_cap, eps0,
+                                 alpha, max_supersteps, refine_waves=0):
+    """Tiered (continuation-priced) twin of _sharded_transport_fn:
+    preemption-on rounds over a device mesh. wLo/wHi/R [C, Mloc]
+    column-local; supply [C], eps0 replicated. Residual rules are the
+    canonical parallel-arc split (solver/layered.py
+    _transport_loop_tiered, which this matches BIT-FOR-BIT at equal
+    refine_waves); the cross-device structure is identical to the
+    plain sharded solve — global in-row prefixes + tiny replicated-row
+    reductions over ICI. refine_waves > 0 enables the tiered price
+    refinement between eps phases (measured ESSENTIAL at preemption
+    scale: 31-58k supersteps/round without it — solver/layered.py
+    _transport_loop_tiered docstring); each wave costs two pmin
+    reductions. Returns (y_local, steps, conv)."""
+    i32 = jnp.int32
+    C, Mloc = wLo.shape
+    U = jnp.minimum(supply[:, None], col_cap[None, :])
+    R = jnp.minimum(R, U)
+
+    def excesses(y, z):
+        e_row = supply - lax.psum(jnp.sum(y, axis=1), AXIS)
+        e_col = jnp.sum(y, axis=0) - z
+        e_sink = lax.psum(jnp.sum(z), AXIS) - jnp.sum(supply)
+        return e_row, e_col, e_sink
+
+    # cold tighten against the CHEAP tier (wLo <= wHi cellwise)
+    live = col_cap > 0
+    pm0 = jnp.where(live, i32(0), -i32(_BIG_D))
+    pr0 = lax.pmax(
+        jnp.max(jnp.where(U > 0, pm0[None, :] - wLo, -i32(_BIG_D)), axis=1),
+        AXIS,
+    )
+    has_arc = lax.psum(jnp.sum((U > 0).astype(i32), axis=1), AXIS) > 0
+    pr0 = jnp.where(has_arc, pr0, i32(0))
+    psink0 = lax.pmin(jnp.min(jnp.where(live, pm0, i32(_BIG_D))), AXIS)
+    psink0 = jnp.where(
+        lax.psum(jnp.sum(live.astype(i32)), AXIS) > 0, psink0, i32(0)
+    )
+
+    def saturate(y, z, pr, pm, psink):
+        # column-local, no collectives
+        return transport_saturate_tiered(
+            wLo, wHi, R, U, col_cap, y, z, pr, pm, psink
+        )
+
+    def saturate_eps(y, z, pr, pm, psink, eps):
+        # column-local (solver/layered.py transport_saturate_eps_tiered)
+        rcl = wLo + pr[:, None] - pm[None, :]
+        rch = wHi + pr[:, None] - pm[None, :]
+        yA = jnp.minimum(y, R)
+        yB = y - yA
+        yA2 = jnp.where(rcl < -eps, R, jnp.where(rcl > eps, i32(0), yA))
+        yB2 = jnp.where(rch < -eps, U - R, jnp.where(rch > eps, i32(0), yB))
+        rcs = pm - psink
+        z2 = jnp.where(rcs < -eps, col_cap, jnp.where(rcs > eps, i32(0), z))
+        return yA2 + yB2, z2
+
+    def price_refine(y, z, pr, pm, psink, eps):
+        """_price_refine_tiered over the mesh: bound_m is column-local
+        (min over replicated rows), bound_r/bound_s are global column
+        minima — one pmin each per wave."""
+        big = i32(_BIG)
+        big_d = i32(_BIG_D)
+
+        def body(_, state):
+            pr, pm, psink = state
+            yA = jnp.minimum(y, R)
+            yB = y - yA
+            bound_m = jnp.minimum(
+                jnp.min(jnp.where(R - yA > 0, wLo + pr[:, None] + eps, big),
+                        axis=0),
+                jnp.min(jnp.where((U - R) - yB > 0, wHi + pr[:, None] + eps,
+                                  big), axis=0),
+            )
+            pm2 = jnp.maximum(jnp.minimum(pm, bound_m), -big_d)
+            pm2 = jnp.minimum(pm2, jnp.where(z > 0, psink + eps, big))
+            bound_r = lax.pmin(
+                jnp.minimum(
+                    jnp.min(jnp.where(yA > 0, pm2[None, :] - wLo + eps, big),
+                            axis=1),
+                    jnp.min(jnp.where(yB > 0, pm2[None, :] - wHi + eps, big),
+                            axis=1),
+                ),
+                AXIS,
+            )
+            pr2 = jnp.maximum(jnp.minimum(pr, bound_r), -big_d)
+            bound_s = lax.pmin(
+                jnp.min(jnp.where(col_cap - z > 0, pm2 + eps, big)), AXIS
+            )
+            psink2 = jnp.maximum(jnp.minimum(psink, bound_s), -big_d)
+            return pr2, pm2, psink2
+
+        return lax.fori_loop(0, refine_waves, body, (pr, pm, psink))
+
+    def superstep(y, z, pr, pm, psink, eps):
+        e_row, e_col, e_sink = excesses(y, z)
+        yA = jnp.minimum(y, R)
+        yB = y - yA
+        rcl = wLo + pr[:, None] - pm[None, :]
+        rch = wHi + pr[:, None] - pm[None, :]
+
+        # rows push forward: both tiers' admissible residuals, one
+        # global in-row exclusive prefix
+        rA = R - yA
+        rB = (U - R) - yB
+        r_adm = jnp.where((rA > 0) & (rcl < 0), rA, i32(0)) + jnp.where(
+            (rB > 0) & (rch < 0), rB, i32(0)
+        )
+        excl = _global_excl_prefix(r_adm, AXIS)
+        delta_f = jnp.clip(e_row[:, None] - excl, 0, r_adm)
+
+        # columns push: sink entry, then dear-tier returns, then cheap
+        # — column-local given replicated pr/psink (same [sink; yB; yA]
+        # exclusive-prefix order as the single-device loop)
+        r_s = col_cap - z
+        adm_s = jnp.where((r_s > 0) & (pm - psink < 0), r_s, i32(0))
+        rcb_hi = pm[None, :] - pr[:, None] - wHi
+        rcb_lo = pm[None, :] - pr[:, None] - wLo
+        adm_bh = jnp.where((yB > 0) & (rcb_hi < 0), yB, i32(0))
+        adm_bl = jnp.where((yA > 0) & (rcb_lo < 0), yA, i32(0))
+        excl_bh = adm_s[None, :] + (jnp.cumsum(adm_bh, axis=0) - adm_bh)
+        excl_bl = (
+            adm_s[None, :]
+            + jnp.sum(adm_bh, axis=0, keepdims=True)
+            + (jnp.cumsum(adm_bl, axis=0) - adm_bl)
+        )
+        delta_s = jnp.clip(e_col, 0, adm_s)
+        delta_bh = jnp.clip(e_col[None, :] - excl_bh, 0, adm_bh)
+        delta_bl = jnp.clip(e_col[None, :] - excl_bl, 0, adm_bl)
+        delta_b = delta_bh + delta_bl
+
+        # sink pushes back along sharded columns: global prefix
+        zb_adm = jnp.where((z > 0) & (psink - pm < 0), z, i32(0))
+        excl_zb = _global_excl_prefix(zb_adm, AXIS)
+        delta_zb = jnp.clip(e_sink - excl_zb, 0, zb_adm)
+
+        y2 = y + delta_f - delta_b
+        z2 = z + delta_s - delta_zb
+
+        # jump relabels: candidates consider both tiers' residuals
+        pushed_row = lax.psum(jnp.sum(delta_f, axis=1), AXIS)
+        # one pmax: max is associative, so combining the two tiers'
+        # LOCAL maxima first is bit-identical and halves the reduction
+        cand_row = lax.pmax(
+            jnp.maximum(
+                jnp.max(jnp.where(rA > 0, pm[None, :] - wLo, -i32(_BIG)),
+                        axis=1),
+                jnp.max(jnp.where(rB > 0, pm[None, :] - wHi, -i32(_BIG)),
+                        axis=1),
+            ),
+            AXIS,
+        )
+        pr2 = jnp.where((e_row > 0) & (pushed_row == 0), cand_row - eps, pr)
+
+        pushed_col = delta_s + jnp.sum(delta_b, axis=0)
+        cand_col = jnp.maximum(
+            jnp.maximum(
+                jnp.max(jnp.where(yA > 0, pr[:, None] + wLo, -i32(_BIG)),
+                        axis=0),
+                jnp.max(jnp.where(yB > 0, pr[:, None] + wHi, -i32(_BIG)),
+                        axis=0),
+            ),
+            jnp.where(r_s > 0, psink, -i32(_BIG)),
+        )
+        pm2 = jnp.where((e_col > 0) & (pushed_col == 0), cand_col - eps, pm)
+
+        pushed_sink = lax.psum(jnp.sum(delta_zb), AXIS)
+        cand_sink = lax.pmax(jnp.max(jnp.where(z > 0, pm, -i32(_BIG))), AXIS)
+        psink2 = jnp.where(
+            (e_sink > 0) & (pushed_sink == 0), cand_sink - eps, psink
+        )
+        return y2, z2, pr2, pm2, psink2
+
+    def phase_cond(state):
+        *_rest, steps, done = state
+        return ~done & (steps < max_supersteps)
+
+    def phase_body(state):
+        y, z, pr, pm, psink, eps, steps, done = state
+        e_row, e_col, e_sink = excesses(y, z)
+        any_active = (
+            jnp.any(e_row > 0)
+            | (lax.psum(jnp.sum((e_col > 0).astype(i32)), AXIS) > 0)
+            | (e_sink > 0)
+        )
+
+        def do_step(_):
+            y2, z2, pr2, pm2, psink2 = superstep(y, z, pr, pm, psink, eps)
+            return y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False)
+
+        def next_phase(_):
+            finished = eps <= 1
+            new_eps = jnp.maximum(i32(1), eps // alpha)
+            if refine_waves:
+                pr2, pm2, psink2 = price_refine(y, z, pr, pm, psink, new_eps)
+                y2, z2 = saturate_eps(y, z, pr2, pm2, psink2, new_eps)
+            else:
+                pr2, pm2, psink2 = pr, pm, psink
+                y2, z2 = saturate(y, z, pr, pm, psink)
+            return (
+                jnp.where(finished, y, y2),
+                jnp.where(finished, z, z2),
+                jnp.where(finished, pr, pr2),
+                jnp.where(finished, pm, pm2),
+                jnp.where(finished, psink, psink2),
+                jnp.where(finished, eps, new_eps),
+                steps,
+                finished,
+            )
+
+        return lax.cond(any_active, do_step, next_phase, operand=None)
+
+    y0 = lax.pcast(jnp.zeros((C, Mloc), i32), (AXIS,), to="varying")
+    z0 = lax.pcast(jnp.zeros((Mloc,), i32), (AXIS,), to="varying")
+    state = (y0, z0, pr0, pm0, psink0, eps0, i32(0), jnp.bool_(False))
+    y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
+        phase_cond, phase_body, state
+    )
+    e_row, e_col, e_sink = excesses(y, z)
+    max_abs = jnp.maximum(
+        jnp.maximum(jnp.max(jnp.abs(e_row)), jnp.abs(e_sink)),
+        lax.pmax(jnp.max(jnp.abs(e_col)), AXIS),
+    )
+    return y, steps, done & (max_abs == 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "alpha", "max_supersteps", "refine_waves"),
+)
+def sharded_transport_solve_tiered(
+    mesh: Mesh, wLo, wHi, R, supply, col_cap, eps0,
+    alpha: int = 8, max_supersteps: int = 1 << 17, refine_waves: int = 0,
+):
+    """Tiered (preemption-on) transport with machine columns sharded
+    over `mesh`'s '{AXIS}' axis — the multi-chip form of the
+    keep-arcs re-solve (graph_manager.go:855-888). wLo/wHi/R
+    int32[C, Mp]; Mp divisible by the mesh size. Returns
+    (y [C, Mp], steps, converged), bit-identical to the single-device
+    tiered solve AT EQUAL refine_waves (production single-device
+    preemption runs refine_waves=8 — pass it here too for the same
+    superstep counts; the host-solver bit-parity convention keeps 0
+    the default)."""
+    fn = jax.shard_map(
+        functools.partial(
+            _sharded_transport_tiered_fn,
+            alpha=alpha, max_supersteps=max_supersteps,
+            refine_waves=refine_waves,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, AXIS), P(None, AXIS), P(None, AXIS), P(None),
+                  P(AXIS), P()),
+        out_specs=(P(None, AXIS), P(), P()),
+    )
+    return fn(wLo, wHi, R, supply, col_cap, eps0)

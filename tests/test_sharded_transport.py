@@ -136,3 +136,49 @@ def test_sharded_superstep_parity_with_single_device():
     # not the ~1.5k that n_scale-from-Mp + a short eps0 start produced
     # (the MULTICHIP_r01 anomaly; see docs/NOTES.md).
     assert 0 < res_sh.supersteps < 500
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("C,M,Mp", [(2, 30, 1024), (4, 200, 1024)])
+def test_sharded_tiered_matches_single_device_exactly(seed, C, M, Mp):
+    """The sharded TIERED (preemption) solve must be bit-identical to
+    the single-device tiered loop — flows and superstep counts — on
+    the virtual 8-device mesh: multi-chip preemption rounds carry the
+    same keep-arcs semantics as single-chip ones."""
+    from ksched_tpu.parallel.sharded_transport import (
+        sharded_transport_solve_tiered,
+    )
+    from ksched_tpu.solver.layered import _transport_loop_tiered
+
+    wS, supply, col_cap = _instance(seed, C, M, Mp)
+    rng = np.random.default_rng(seed + 31)
+    n_scale = 2048
+    discount = int(rng.integers(1, 10)) * n_scale
+    wHi = wS
+    wLo = wS.copy()
+    wLo[:, :M] -= discount
+    R = rng.integers(0, 5, (C, Mp)).astype(np.int32)
+    R[:, -1] = 0
+    eps0 = np.int32(max(1, np.abs(wHi).max()))
+    mesh = _mesh()
+    RJ = jnp.minimum(
+        jnp.asarray(R),
+        jnp.minimum(jnp.asarray(supply)[:, None], jnp.asarray(col_cap)[None, :]),
+    )
+    U = jnp.minimum(jnp.asarray(supply)[:, None], jnp.asarray(col_cap)[None, :])
+    # both refinement regimes: refine 0 (the host bit-parity
+    # convention) and refine 8 (the production preemption setting)
+    for refine in (0, 8):
+        y_sh, steps_sh, conv_sh = sharded_transport_solve_tiered(
+            mesh, jnp.asarray(wLo), jnp.asarray(wHi), jnp.asarray(R),
+            jnp.asarray(supply), jnp.asarray(col_cap), jnp.asarray(eps0),
+            refine_waves=refine,
+        )
+        y_1, _z, _pm, steps_1, conv_1 = _transport_loop_tiered(
+            jnp.asarray(wLo), jnp.asarray(wHi), RJ, U,
+            jnp.asarray(supply), jnp.asarray(col_cap),
+            jnp.asarray(eps0), 8, 1 << 17, refine_waves=refine,
+        )
+        assert bool(conv_sh) and bool(conv_1), refine
+        assert int(steps_sh) == int(steps_1), refine
+        np.testing.assert_array_equal(np.asarray(y_sh), np.asarray(y_1))
