@@ -131,8 +131,13 @@ def _round_latency_model(chunk_walls_ms, R, ss_per_chunk, full_per_chunk=None):
         ss_f = np.zeros_like(ss_i)
 
     def _fit(w, si, sf):
-        """(t_fixed, k_i, k_f, fit_kind) for chunk walls w."""
-        if mixture and len(w) >= 3 and np.ptp(si) > 0 and np.ptp(sf) > 0:
+        """(t_fixed, k_i, k_f, fit_kind) for chunk walls w. The
+        2-regime fit needs >= 4 chunks: with exactly 3 the 3-parameter
+        system is exactly determined (zero residual df) and fits noise
+        — a 3-chunk suite run produced k_incr > k_full, which is
+        nonsense; the merged-slope model with its LOO check is the
+        honest fallback there."""
+        if mixture and len(w) >= 4 and np.ptp(si) > 0 and np.ptp(sf) > 0:
             A = np.stack([np.full_like(si, R), si, sf], axis=1)
             (tf, ki, kf), *_ = np.linalg.lstsq(A, w, rcond=None)
             if tf >= 0 and ki >= 0 and kf >= 0:
@@ -172,9 +177,11 @@ def _round_latency_model(chunk_walls_ms, R, ss_per_chunk, full_per_chunk=None):
         out["per_superstep_us_full"] = round(k_f * 1e3, 4)
     if len(walls) >= 3:
         # a fold only counts when its subfit ran in the SAME regime as
-        # the full fit — e.g. with 3 mixture chunks each 2-chunk subfit
-        # can only do the merged-slope model, and judging the 2-regime
-        # fit by a merged-slope prediction would flag clean fits
+        # the full fit — a 4-chunk mixture run's 3-chunk subfits can
+        # only do the merged-slope model, and judging the 2-regime fit
+        # by a merged-slope prediction would flag clean fits (hybrid
+        # configs therefore measure 5 chunks: 4-chunk subfits keep the
+        # 2-regime form and the LOO check stays live)
         errs = []
         for i in range(len(walls)):
             keep = np.arange(len(walls)) != i
@@ -325,7 +332,13 @@ def _device_bench(
         # round variance, or a sub-bar reading the probe's 4x margin
         # missed): retry it once, then GROW R and restart measurement
         # rather than reporting a number the bar does not cover
-        chunks = max(3, -(-rounds // R))  # >= 3 chunks for the p50
+        # >= 3 chunks for the p50; hybrid-preempt configs take 5 so
+        # the TWO-REGIME latency fit is over-determined (3 params) AND
+        # its leave-one-out folds (4-chunk subfits) can run the same
+        # regime — at 3 chunks the mixture fit is exactly determined
+        # and fits noise (a suite run produced k_incr > k_full)
+        hybrid_cfg = preemption and (preempt_every > 1 or preempt_drift > 0)
+        chunks = max(5 if hybrid_cfg else 3, -(-rounds // R))
         per_round_ms = []
         chunk_walls_ms = []
         chunk_stats = []

@@ -449,3 +449,38 @@ def test_hybrid_preemption_checkpoint_roundtrip(tmp_path):
         assert np.array_equal(np.asarray(v), np.asarray(dev.fetch_state()[k])), k
     s = back.fetch_stats(back.run_steady_rounds(8, 0.05, 10, seed=3))
     assert s["converged"].all()
+
+
+def test_hybrid_preemption_replay_scan():
+    """The stability-aware branches must also serve the REPLAY scan
+    (run_replay_rounds): staged completions/admissions/toggles chain
+    through the hybrid carry, full rounds fire on cadence, and
+    occupancy invariants hold at the end."""
+    dev = _hybrid_cluster(every=4, drift=0, T=200)
+    K, Amax, Dmax, Emax = 12, 8, 4, 2
+    rng = np.random.default_rng(3)
+    sch = {
+        "adm_job": rng.integers(0, 4, (K, Amax)).astype(np.int32),
+        "adm_cls": rng.integers(0, 4, (K, Amax)).astype(np.int32),
+        "adm_grp": np.zeros((K, Amax), np.int32),
+        "adm_n": np.full(K, Amax, np.int32),
+        "done_rows": np.full((K, Dmax), dev.Tcap, np.int32),
+        "done_n": np.zeros(K, np.int32),
+        "tog_idx": np.zeros((K, Emax), np.int32),
+        "tog_on": np.ones((K, Emax), bool),
+        "tog_n": np.zeros(K, np.int32),
+        "rounds": K,
+    }
+    # retire a fixed early row block in later windows (they were
+    # admitted by the fill in _hybrid_cluster)
+    for i in range(4, K):
+        sch["done_rows"][i, :2] = [(i - 4) * 2, (i - 4) * 2 + 1]
+        sch["done_n"][i] = 2
+    s = dev.fetch_stats(dev.run_replay_rounds(sch, seed=5))
+    assert s["converged"].all()
+    full = s["full_round"].astype(bool)
+    assert full.sum() == K // 4 and (np.nonzero(full)[0] % 4 == 3).all()
+    st = {k: np.asarray(v) for k, v in dev.fetch_state().items()}
+    on = st["live"] & (st["pu"] >= 0)
+    recount = np.bincount(st["pu"][on], minlength=dev.num_pus)
+    assert (recount == st["pu_running"]).all()
