@@ -181,12 +181,12 @@ def try_collapse(problem) -> Tuple[Optional[GraphCollapse], str]:
         m = int(m)
         children: Dict[int, List[Tuple[int, int]]] = {}
         path_cost: Optional[int] = None
-        ok = True
+        defect: Optional[str] = None
 
         def walk(v: int, acc: int) -> int:
             """Returns remaining capacity-to-sink of v; records the
             children arcs; checks the unique-path-cost condition."""
-            nonlocal path_cost, ok
+            nonlocal path_cost, defect
             total_cap = 0
             kids: List[Tuple[int, int]] = []
             for a in out.get(v, []):
@@ -196,7 +196,7 @@ def try_collapse(problem) -> Tuple[Optional[GraphCollapse], str]:
                     if path_cost is None:
                         path_cost = c
                     elif path_cost != c:
-                        ok = False
+                        defect = "non-uniform interior path costs"
                     kids.append((a, -1))
                     total_cap += int(cap_res[a])
                 elif int(nt[d]) in _BELOW_MACHINE:
@@ -204,20 +204,20 @@ def try_collapse(problem) -> Tuple[Optional[GraphCollapse], str]:
                         # reached twice — from another machine OR from
                         # this one (diamond/cycle): either way not a
                         # tree; refuse rather than double-count
-                        ok = False
+                        defect = "non-tree interior (shared/diamond node)"
                         continue
                     claimed[d] = m
                     sub = walk(d, acc + int(cost[a]))
                     kids.append((a, d))
                     total_cap += min(int(cap_res[a]), sub)
                 else:
-                    ok = False  # machine interior reaching a non-resource
+                    defect = "interior arc to a non-resource node"
             children[v] = kids
             return total_cap
 
         capacity = walk(m, 0)
-        if not ok:
-            return _refuse(f"machine {m}: non-uniform or non-tree interior")
+        if defect is not None:
+            return _refuse(f"machine {m}: {defect}")
         if path_cost is None:
             capacity, path_cost = 0, 0  # no route to sink: dead column
         col_of[m] = len(machines)

@@ -199,3 +199,74 @@ def test_auto_backend_keep_mode_routes_csr():
     auto_trace, _ = drive_obj(auto, preemption=True)
     assert auto.last_path == "csr"
     assert auto_trace == ref_trace
+
+
+def test_try_collapse_structural_refusals():
+    """Direct structural edge cases of the collapse audit: a diamond
+    below one machine (double-counted capacity / non-tree), and a
+    machine whose two sink paths carry different total costs, must
+    both REFUSE — not crash, not collapse."""
+    from ksched_tpu.graph.device_export import FlowProblem
+    from ksched_tpu.graph.flowgraph import NodeType
+    from ksched_tpu.solver.graph_collapse import try_collapse
+
+    def make(node_types, arcs, excesses):
+        """node ids start at 1 (row 0 padding)."""
+        N = len(node_types) + 1
+        nt = np.full(N, -1, np.int8)
+        ex = np.zeros(N, np.int64)
+        for i, t in enumerate(node_types, start=1):
+            nt[i] = int(t)
+        for i, e in excesses.items():
+            ex[i] = e
+        src = np.array([a[0] for a in arcs], np.int32)
+        dst = np.array([a[1] for a in arcs], np.int32)
+        cap = np.array([a[2] for a in arcs], np.int32)
+        cost = np.array([a[3] for a in arcs], np.int32)
+        return FlowProblem(
+            num_nodes=N, excess=ex, node_type=nt, src=src, dst=dst,
+            cap=cap, cost=cost,
+            flow_offset=np.zeros(len(arcs), np.int32),
+            num_arcs=len(arcs),
+        )
+
+    T = NodeType
+    # nodes: 1=sink, 2=task, 3=agg, 4=machine, 5=PU-a, 6=PU-b
+    base_types = [T.SINK, T.UNSCHEDULED_TASK, T.JOB_AGGREGATOR,
+                  T.MACHINE, T.PU, T.PU]
+
+    # diamond: machine -> PU-a twice (two parallel arcs into the same
+    # subtree) — capacity must NOT double-count; audit refuses
+    p = make(
+        base_types,
+        [(2, 3, 1, 7), (3, 1, 4, 0), (2, 4, 1, 2),
+         (4, 5, 1, 0), (4, 5, 1, 0), (5, 1, 1, 0)],
+        {2: 1, 1: -1},
+    )
+    gc, reason = try_collapse(p)
+    assert gc is None and "non-tree" in reason, reason
+
+    # non-uniform path costs: machine -> PU-a (cost 0) -> sink and
+    # machine -> PU-b (cost 3) -> sink give the column two different
+    # totals; audit refuses
+    p = make(
+        base_types,
+        [(2, 3, 1, 7), (3, 1, 4, 0), (2, 4, 1, 2),
+         (4, 5, 1, 0), (4, 6, 1, 3), (5, 1, 1, 0), (6, 1, 1, 0)],
+        {2: 1, 1: -1},
+    )
+    gc, reason = try_collapse(p)
+    assert gc is None and "non-uniform" in reason, reason
+
+    # the well-formed twin of the same shape COLLAPSES (sanity: the
+    # refusals above are about the defects, not the harness)
+    p = make(
+        base_types,
+        [(2, 3, 1, 7), (3, 1, 4, 0), (2, 4, 1, 2),
+         (4, 5, 1, 0), (4, 6, 1, 0), (5, 1, 1, 0), (6, 1, 1, 0)],
+        {2: 1, 1: -1},
+    )
+    gc, reason = try_collapse(p)
+    assert gc is not None, reason
+    assert gc.col_cap.tolist() == [2]  # two PU slots under one machine
+    assert gc.row_unsched.tolist() == [7]
