@@ -245,8 +245,10 @@ def main(argv=None) -> int:
         default="trivial",
     )
     ap.add_argument(
-        "--backend", choices=["ref", "native", "jax"], default="native",
-        help="MCMF backend (native C++ is the CPU production default)",
+        "--backend", choices=["ref", "native", "jax", "auto"],
+        default="native",
+        help="MCMF backend (native C++ is the CPU production default; "
+        "auto = per-solve dense-vs-CSR dispatch, solver/graph_collapse.py)",
     )
     ap.add_argument("--podgen", type=int, default=0, metavar="N",
                     help="generate N pods in-process (cmd/podgen equivalent)")
