@@ -1255,7 +1255,8 @@ def build(args):
 
     from ksched_tpu.solver.select import make_backend
 
-    backend = make_backend(args.backend, warm_start=not args.cold, fallback=False)
+    name = "auto" if args.backend == "autograph" else args.backend
+    backend = make_backend(name, warm_start=not args.cold, fallback=False)
     cluster = BulkCluster(
         num_machines=args.machines,
         pus_per_machine=args.pus,
@@ -1281,12 +1282,15 @@ def main():
     ap.add_argument("--cpu", action="store_true", help="run host-only on JAX-CPU (skip the accelerator); combine with --backend native/ref for the host solver paths")
     ap.add_argument(
         "--backend",
-        choices=["auto", "device", "layered", "jax", "native", "ref"],
+        choices=["auto", "device", "layered", "jax", "native", "ref",
+                 "autograph"],
         default="auto",
         help=(
             "scheduling path: device = device-resident cluster (the TPU "
             "production path), layered/jax/native/ref = host cluster with "
-            "that MCMF backend; auto = device"
+            "that MCMF backend, autograph = host cluster with the "
+            "per-solve dense-vs-CSR dispatch (make_backend('auto')); "
+            "auto = device"
         ),
     )
     ap.add_argument(
