@@ -43,6 +43,16 @@ def _accelerator_alive(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _emit_record(out: dict, args) -> None:
+    """Print one JSON record, stamping accelerator_unreachable when
+    this process (or the suite parent that spawned it) fell back from
+    a wedged accelerator — a CPU-host reading must be machine-
+    distinguishable from a device measurement in EVERY record."""
+    if getattr(args, "fell_back", False):
+        out["accelerator_unreachable"] = True
+    print(json.dumps(out))
+
+
 def _solver_work(backend) -> int:
     """Iterations/supersteps the backend spent on its last solve."""
     return getattr(backend, "last_supersteps", None) or getattr(backend, "last_iterations", 0)
@@ -465,7 +475,7 @@ def run_device_bench(args) -> None:
             "closed form (supersteps 0); iterative-solver flagships are "
             "quincy10k / coco50k / whare-hetero in --suite"
         )
-    print(json.dumps(out))
+    _emit_record(out, args)
 
 
 #: the five BASELINE.json benchmark configs plus the Quincy
@@ -659,7 +669,7 @@ def run_config(args) -> None:
     else:
         raise SystemExit(f"unknown config {name!r}; choose from {SUITE_CONFIGS}")
     out["config"] = name
-    print(json.dumps(out))
+    _emit_record(out, args)
 
 
 def _quincy_multiblock_bench(
@@ -1234,6 +1244,8 @@ def run_suite(args) -> None:
                "--rounds", str(args.rounds), "--chunk", str(args.chunk)]
         if args.cpu:
             cmd.append("--cpu")
+        if getattr(args, "fell_back", False):
+            cmd.append("--fell-back")
         if args.verbose:
             cmd.append("--verbose")
         r = subprocess.run(cmd, capture_output=True, text=True)
@@ -1315,13 +1327,17 @@ def main():
         "after a provenance stamp line",
     )
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--fell-back", dest="fell_back_flag",
+                    action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.small:
         args.tasks, args.machines, args.rounds = 100, 10, 128
+    args.fell_back = getattr(args, "fell_back_flag", False)
     if not args.cpu and not _accelerator_alive():
         print("# accelerator unreachable; falling back to cpu", file=sys.stderr)
         args.cpu = True
+        args.fell_back = True
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         from ksched_tpu.utils import force_cpu_platform
@@ -1379,19 +1395,18 @@ def main():
 
     p50 = float(np.percentile(lat_ms, 50))
     target_ms = 10.0
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"p50 scheduling-round latency, {args.tasks} tasks x "
-                    f"{args.machines} machines, trivial cost model, "
-                    f"{args.churn:.0%} churn, backend={args.backend}/{devices[0].platform}"
-                ),
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(target_ms / p50, 3),
-            }
-        )
+    _emit_record(
+        {
+            "metric": (
+                f"p50 scheduling-round latency, {args.tasks} tasks x "
+                f"{args.machines} machines, trivial cost model, "
+                f"{args.churn:.0%} churn, backend={args.backend}/{devices[0].platform}"
+            ),
+            "value": round(p50, 3),
+            "unit": "ms",
+            "vs_baseline": round(target_ms / p50, 3),
+        },
+        args,
     )
 
 
