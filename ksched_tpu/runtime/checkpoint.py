@@ -240,6 +240,19 @@ def save_device_checkpoint(cluster, path: str) -> None:
         f"s_{name}": np.asarray(v)
         for name, v in cluster.fetch_state().items()
     }
+    if cluster.hybrid_preempt:
+        # the stability-aware carry: census at the last full re-solve
+        # and rounds since — restoring it resumes the exact cadence
+        # instead of conservatively re-firing a full round (fetched as
+        # ONE extra transfer, keeping save near the one-bulk-fetch
+        # discipline above)
+        import jax
+
+        hyb_census, hyb_k = jax.device_get(
+            (cluster._hyb_census, cluster._hyb_k)
+        )
+        arrays["hyb_census"] = np.asarray(hyb_census)
+        meta["hyb_k"] = int(hyb_k)
     if cluster.grouped:
         got = {k: np.asarray(v) for k, v in cluster.groups._asdict().items()}
         arrays.update({f"g_{name}": got[name] for name in _DEVICE_GROUPS})
@@ -312,4 +325,7 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
         cluster.set_groups(
             **{name: data[f"g_{name}"] for name in _DEVICE_GROUPS}
         )
+    if cluster.hybrid_preempt and "hyb_census" in data:
+        cluster._hyb_census = jnp.asarray(data["hyb_census"])
+        cluster._hyb_k = jnp.int32(meta.get("hyb_k", cluster.preempt_every - 1))
     return cluster

@@ -423,9 +423,11 @@ def test_hybrid_preemption_objective_drift_bounded():
 
 
 def test_hybrid_preemption_checkpoint_roundtrip(tmp_path):
-    """Hybrid-mode checkpoints carry preempt_every/preempt_drift and
-    restored clusters keep scheduling (the drift reference resets at
-    the next one-shot round, which is always full)."""
+    """Hybrid-mode checkpoints carry preempt_every/preempt_drift AND
+    the stability carry (drift-reference census + rounds-since-full),
+    so a restored cluster resumes the EXACT cadence in lockstep with
+    the original — identical full-round schedules, bit-identical
+    states."""
     from ksched_tpu.costmodels import coco
     from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
     from ksched_tpu.runtime.checkpoint import (
@@ -447,8 +449,18 @@ def test_hybrid_preemption_checkpoint_roundtrip(tmp_path):
     assert back.hybrid_preempt
     for k, v in back.fetch_state().items():
         assert np.array_equal(np.asarray(v), np.asarray(dev.fetch_state()[k])), k
-    s = back.fetch_stats(back.run_steady_rounds(8, 0.05, 10, seed=3))
-    assert s["converged"].all()
+    # the hybrid carry round-trips too: original and restored proceed
+    # in LOCKSTEP — identical full-round schedules and bit-identical
+    # states (exact cadence resume, not a conservative re-fire)
+    assert np.array_equal(np.asarray(back._hyb_census),
+                          np.asarray(dev._hyb_census))
+    assert int(back._hyb_k) == int(dev._hyb_k)
+    sa = dev.fetch_stats(dev.run_steady_rounds(8, 0.05, 10, seed=3))
+    sb = back.fetch_stats(back.run_steady_rounds(8, 0.05, 10, seed=3))
+    assert sa["converged"].all() and sb["converged"].all()
+    assert np.array_equal(sa["full_round"], sb["full_round"])
+    for k, v in back.fetch_state().items():
+        assert np.array_equal(np.asarray(v), np.asarray(dev.fetch_state()[k])), k
 
 
 def test_hybrid_preemption_replay_scan():
