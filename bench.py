@@ -404,6 +404,7 @@ def _device_bench(
             file=sys.stderr,
         )
     ss_all, full_all, glob_all, placed_all, live_last = [], [], [], [], 0
+    drift_all = []
     for rep, stats in enumerate(chunk_stats):
         got = dev.fetch_stats(stats)
         assert got["converged"].all(), "a steady round did not converge"
@@ -414,6 +415,8 @@ def _device_bench(
             full_all.append(np.asarray(got["full_round"]))
         if "global_round" in got:
             glob_all.append(np.asarray(got["global_round"]))
+        if "census_drift" in got:
+            drift_all.append(np.asarray(got["census_drift"]))
         placed_all.append(np.asarray(got["placed"]))
         live_last = int(got["live"][-1])
         if verbose:
@@ -448,6 +451,35 @@ def _device_bench(
         if full_all:
             detail["full_rounds"] = int(np.concatenate(full_all).sum())
             detail["rounds_total"] = int(sum(len(f) for f in full_all))
+        # forensic anchor for the max tail (VERDICT r4 #5): the top
+        # rounds by superstep count, each with its tier and context,
+        # so an artifact reader can see WHICH regime the monsters live
+        # in without a re-run
+        k = min(8, len(ss_cat))
+        top = np.argsort(ss_cat)[-k:][::-1]
+        fcat_t = np.concatenate(full_all).astype(bool) if full_all else None
+        gcat_t = np.concatenate(glob_all).astype(bool) if glob_all else None
+        dcat_t = np.concatenate(drift_all) if drift_all else None
+        detail["top_rounds"] = [
+            {
+                "round": int(i),
+                "supersteps": int(ss_cat[i]),
+                **(
+                    {
+                        "tier": (
+                            "global" if gcat_t is not None and gcat_t[i]
+                            else "scoped" if fcat_t[i] else "incremental"
+                        )
+                    }
+                    if fcat_t is not None else {}
+                ),
+                **(
+                    {"census_drift": int(dcat_t[i])}
+                    if dcat_t is not None else {}
+                ),
+            }
+            for i in top
+        ]
         if glob_all and preempt_global_every > 0:
             detail["global_rounds"] = int(np.concatenate(glob_all).sum())
             # scoped-regime evidence: the p99 claim rests on scoped
@@ -681,11 +713,15 @@ def run_config(args) -> None:
             verbose=args.verbose,
         )
     elif name == "gtrace12k":
-        out = _gtrace_device_bench(verbose=args.verbose)
+        out = _gtrace_device_bench(verbose=args.verbose, overrides=args.override)
     elif name == "gtrace12k-burst":
-        out = _gtrace_device_bench(verbose=args.verbose, burst=True)
+        out = _gtrace_device_bench(
+            verbose=args.verbose, burst=True, overrides=args.override
+        )
     elif name == "gtrace12k-coco":
-        out = _gtrace_device_bench(verbose=args.verbose, cost_model="coco")
+        out = _gtrace_device_bench(
+            verbose=args.verbose, cost_model="coco", overrides=args.override
+        )
     elif name == "gtrace12k-host":
         from ksched_tpu.drivers.trace_replay import TraceReplayDriver, synthesize_trace
         from ksched_tpu.solver.layered import LayeredTransportSolver
@@ -1071,6 +1107,7 @@ def _multiblock_quality_probe(
 def _gtrace_device_bench(
     verbose: bool = False, burst: bool = False,
     cost_model: Optional[str] = None,
+    overrides: Optional[list] = None,
 ) -> dict:
     """BASELINE config 5 on the PRODUCTION path: Google-trace replay at
     12.5k machines through DeviceBulkCluster's scanned replay program
@@ -1132,6 +1169,27 @@ def _gtrace_device_bench(
     if cost_model:
         slots_per_machine = 2
         rate = 160.0 if platform != "cpu" else 60.0
+    decode_width = 4096
+    task_capacity = 1 << 16 if (burst or cost_model) else 1 << 15
+    # --override k=v ablation knobs (round-anatomy forensics — a
+    # deviation from the named config is recorded in the metric line)
+    ov = {}
+    for kv in overrides or []:
+        k, _, v = kv.partition("=")
+        ov[k] = float(v) if "." in v else int(v)
+    n_machines = int(ov.get("n_machines", n_machines))
+    rate = float(ov.get("rate", rate))
+    slots_per_machine = int(ov.get("slots_per_machine", slots_per_machine))
+    decode_width = int(ov.get("decode_width", decode_width))
+    task_capacity = int(ov.get("task_capacity", task_capacity))
+    if "n_windows" in ov:
+        n_windows = int(ov["n_windows"])
+    unknown = set(ov) - {
+        "n_machines", "rate", "slots_per_machine", "decode_width",
+        "task_capacity", "n_windows",
+    }
+    if unknown:
+        raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
     duration_s = n_windows * window_s
     num_tasks = int(duration_s * rate)
     burst_kw = {}
@@ -1167,8 +1225,8 @@ def _gtrace_device_bench(
         raise SystemExit(f"unknown gtrace cost_model {cost_model!r}")
     driver = DeviceTraceReplayDriver(
         machines, slots_per_machine=slots_per_machine, num_jobs_hint=64,
-        task_capacity=1 << 16 if (burst or cost_model) else 1 << 15,
-        decode_width=4096,
+        task_capacity=task_capacity,
+        decode_width=decode_width,
         **policy_kw,
     )
     t0 = time.perf_counter()
@@ -1256,6 +1314,8 @@ def _gtrace_device_bench(
     )
     ss_cat = np.concatenate(ss_all)
     detail["supersteps_p50"] = int(np.percentile(ss_cat, 50))
+    if ov:
+        detail["overrides"] = {k: ov[k] for k in sorted(ov)}
     policy_tag = (
         "CoCo census-priced classes (iterative transport every window)"
         if cost_model == "coco" else "per-job unsched"
@@ -1420,6 +1480,13 @@ def main():
         help="suite artifact path (default: BENCH_SUITE.jsonl next to "
         "bench.py); written incrementally, one JSON line per config "
         "after a provenance stamp line",
+    )
+    ap.add_argument(
+        "--override", action="append", default=[], metavar="K=V",
+        help="config-knob override for round-anatomy ablations "
+        "(gtrace configs: n_machines, rate, slots_per_machine, "
+        "decode_width, task_capacity, n_windows); recorded in the "
+        "output record",
     )
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument("--fell-back", dest="fell_back_flag",

@@ -324,8 +324,12 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
         preempt_drift=meta.get("preempt_drift", 0),
         preempt_global_every=meta.get("preempt_global_every", 0),
         preempt_scope_tau=meta.get("preempt_scope_tau", 1),
+        # explicit None test: a saved width of 0 is a legal (if
+        # degenerate) configuration and must round-trip as 0, not None
         preempt_scoped_width=(
-            None if (meta.get("preempt_scoped_width") or -1) < 0
+            None
+            if meta.get("preempt_scoped_width") is None
+            or meta["preempt_scoped_width"] < 0
             else meta["preempt_scoped_width"]
         ),
         track_realized_cost=bool(meta.get("track_realized_cost", 0)),

@@ -1,0 +1,146 @@
+"""Bucketed-ELL push-relabel (solver/ell_solver.py): parity vs the
+exact CPU oracle and the CSR JaxSolver.
+
+Same invariant as test_jax_solver.py: MCMF optima are non-unique, so
+parity = identical objective; flow validity checked directly. The ELL
+layout additionally gets structural tests (every doubled entry lands in
+exactly one block cell; hub row-splitting covers hub degrees).
+"""
+
+import numpy as np
+import pytest
+
+from ksched_tpu.solver import ReferenceSolver
+from ksched_tpu.solver.ell_solver import EllSolver, build_ell_plan
+from ksched_tpu.solver.jax_solver import JaxSolver
+
+from test_jax_solver import (
+    assert_valid_flow,
+    random_scheduling_problem,
+)
+from test_solver_oracle import make_problem
+
+
+def test_plan_structure():
+    rng = np.random.default_rng(3)
+    p = random_scheduling_problem(
+        rng, num_tasks=40, num_machines=4, slots_per_machine=3
+    )
+    src = p.src.astype(np.int32)
+    dst = p.dst.astype(np.int32)
+    plan = build_ell_plan(src, dst, p.num_nodes, w_small=8, w_hub=16)
+    m = len(src)
+    deg = np.bincount(np.concatenate([src, dst]), minlength=p.num_nodes)
+    # hub split must exist at this scale (unsched/EC/sink are hubs)
+    assert (deg > 8).any()
+    # every doubled entry occupies exactly one cell: total non-pad cells
+    assert int((plan.s_sign != 0).sum() + (plan.h_sign != 0).sum()) == 2 * m
+    # fwd/bwd flat positions address distinct cells
+    assert len(np.unique(np.concatenate([plan.fwd_flat, plan.bwd_flat]))) == 2 * m
+    # per-node bookkeeping: each small node's row carries exactly deg entries
+    for row in range(min(10, len(plan.s_node))):
+        node = plan.s_node[row]
+        if plan.node_kind[node] == 1 and plan.node_slot[node] == row:
+            assert int((plan.s_sign[row] != 0).sum()) == int(deg[node])
+    # hub rows, concatenated in k order, carry exactly the hub's degree
+    for h in range(len(plan.hub_node)):
+        rows = plan.hub_rows[h][plan.hub_rows_valid[h]]
+        if len(rows) == 0:
+            continue
+        node = plan.hub_node[h]
+        assert int((plan.h_sign[rows] != 0).sum()) == int(deg[node])
+        assert (plan.h_node[rows] == node).all()
+
+
+@pytest.mark.parametrize("case", ["single", "cheap", "split", "assign", "escape"])
+def test_small_parity(case):
+    problems = {
+        "single": make_problem(4, {1: 1, 3: -1}, [(1, 2, 0, 1, 2), (2, 3, 0, 1, 3)]),
+        "cheap": make_problem(
+            4, {1: 1, 3: -1}, [(1, 3, 0, 1, 10), (1, 2, 0, 1, 2), (2, 3, 0, 1, 3)]
+        ),
+        "split": make_problem(
+            4, {1: 2, 3: -2}, [(1, 3, 0, 9, 10), (1, 2, 0, 1, 2), (2, 3, 0, 9, 3)]
+        ),
+        "assign": make_problem(
+            8,
+            {1: 1, 2: 1, 6: -2},
+            [
+                (1, 3, 0, 1, 2),
+                (2, 3, 0, 1, 2),
+                (3, 4, 0, 1, 0),
+                (3, 5, 0, 1, 4),
+                (4, 6, 0, 1, 0),
+                (5, 6, 0, 1, 0),
+                (1, 7, 0, 1, 50),
+                (2, 7, 0, 1, 50),
+                (7, 6, 0, 2, 0),
+            ],
+        ),
+        "escape": make_problem(
+            8,
+            {1: 1, 2: 1, 6: -2},
+            [
+                (1, 3, 0, 1, 2),
+                (2, 3, 0, 1, 2),
+                (3, 4, 0, 1, 0),
+                (4, 6, 0, 1, 0),
+                (1, 7, 0, 1, 5),
+                (2, 7, 0, 1, 5),
+                (7, 6, 0, 2, 0),
+            ],
+        ),
+    }
+    p = problems[case]
+    ref = ReferenceSolver().solve(p)
+    el = EllSolver().solve(p)
+    assert_valid_flow(p, el.flow)
+    assert el.objective == ref.objective
+
+
+def test_random_parity_vs_oracle_and_csr():
+    rng = np.random.default_rng(11)
+    for trial in range(8):
+        p = random_scheduling_problem(
+            rng,
+            num_tasks=int(rng.integers(3, 40)),
+            num_machines=int(rng.integers(1, 6)),
+            slots_per_machine=int(rng.integers(1, 4)),
+        )
+        ref = ReferenceSolver().solve(p)
+        el = EllSolver(w_hub=16).solve(p)
+        jx = JaxSolver().solve(p)
+        assert el.objective == ref.objective, f"trial {trial}"
+        assert jx.objective == el.objective, f"trial {trial}"
+        assert_valid_flow(p, el.flow)
+
+
+def test_warm_start_incremental():
+    rng = np.random.default_rng(5)
+    p = random_scheduling_problem(
+        rng, num_tasks=12, num_machines=3, slots_per_machine=2
+    )
+    solver = EllSolver(w_hub=16)
+    r1 = solver.solve(p)
+    ref1 = ReferenceSolver().solve(p)
+    assert r1.objective == ref1.objective
+    cold_steps = solver.last_supersteps
+
+    from ksched_tpu.graph.device_export import FlowProblem
+
+    p2 = FlowProblem(
+        num_nodes=p.num_nodes,
+        excess=p.excess.copy(),
+        node_type=p.node_type,
+        src=p.src,
+        dst=p.dst,
+        cap=p.cap.copy(),
+        cost=p.cost.copy(),
+        flow_offset=p.flow_offset,
+        num_arcs=p.num_arcs,
+    )
+    p2.cost[0] += 2
+    r2 = solver.solve(p2)
+    ref2 = ReferenceSolver().solve(p2)
+    assert r2.objective == ref2.objective
+    assert solver.last_supersteps <= max(cold_steps * 2, 50)

@@ -630,3 +630,55 @@ def test_three_tier_checkpoint_lockstep(tmp_path):
     assert np.array_equal(sa["global_round"], sb["global_round"])
     for k, v in back.fetch_state().items():
         assert np.array_equal(np.asarray(v), np.asarray(dev.fetch_state()[k])), k
+
+
+def test_checkpoint_scoped_width_zero_roundtrip(tmp_path):
+    """A saved preempt_scoped_width of 0 (legal, degenerate: every
+    scoped-round mover parks) must restore as 0, not be falsy-coerced
+    to None (= Tcap-wide decode) — the restored cluster would grant
+    movers the original parked, breaking lockstep resume."""
+    from ksched_tpu.costmodels import coco
+    from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
+    from ksched_tpu.runtime.checkpoint import (
+        load_device_checkpoint,
+        save_device_checkpoint,
+    )
+
+    rng = np.random.default_rng(3)
+    penalties = rng.integers(0, 40, (16, 4)).astype(np.int64)
+    dev = DeviceBulkCluster(
+        num_machines=16, pus_per_machine=2, slots_per_pu=2, num_jobs=2,
+        num_task_classes=4, task_capacity=256,
+        class_cost_fn=coco_device_cost_fn(penalties),
+        unsched_cost=coco.UNSCHEDULED_COST, ec_cost=0,
+        supersteps=1 << 14, preemption=True, continuation_discount=8,
+        preempt_every=2, preempt_global_every=8,
+        preempt_scoped_width=0, decode_width=64,
+    )
+    dev.add_tasks(60, rng.integers(0, 2, 60).astype(np.int32),
+                  rng.integers(0, 4, 60).astype(np.int32))
+    jax.block_until_ready(dev.round())
+    path = str(tmp_path / "w0.npz")
+    save_device_checkpoint(dev, path)
+    back = load_device_checkpoint(
+        path, class_cost_fn=coco_device_cost_fn(penalties)
+    )
+    assert back.preempt_scoped_width == 0
+    # and a plain None width still restores as None
+    dev2 = DeviceBulkCluster(
+        num_machines=16, pus_per_machine=2, slots_per_pu=2, num_jobs=2,
+        num_task_classes=4, task_capacity=256,
+        class_cost_fn=coco_device_cost_fn(penalties),
+        unsched_cost=coco.UNSCHEDULED_COST, ec_cost=0,
+        supersteps=1 << 14, preemption=True, continuation_discount=8,
+        preempt_every=2, preempt_global_every=8, decode_width=64,
+    )
+    dev2.add_tasks(60, rng.integers(0, 2, 60).astype(np.int32),
+                   rng.integers(0, 4, 60).astype(np.int32))
+    jax.block_until_ready(dev2.round())
+    path2 = str(tmp_path / "wn.npz")
+    save_device_checkpoint(dev2, path2)
+    back2 = load_device_checkpoint(
+        path2, class_cost_fn=coco_device_cost_fn(penalties)
+    )
+    assert back2.preempt_scoped_width is None
