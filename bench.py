@@ -647,6 +647,14 @@ def run_config(args) -> None:
     elif name == "coco50k-preempt":
         from ksched_tpu.costmodels import coco
 
+        pov = {}
+        for kv in args.override or []:
+            k, _, v = kv.partition("=")
+            pov[k] = int(v)
+        unknown = set(pov) - {"preempt_drift", "preempt_every",
+                              "preempt_global_every", "preempt_scope_tau"}
+        if unknown:
+            raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
         penalties = rng.integers(0, 40, (1_000, 4)).astype(np.int64)
         out = _device_bench(
             tasks=50_000, machines=1_000, pus=4, slots=16, jobs=20,
@@ -668,8 +676,8 @@ def run_config(args) -> None:
             # does (placement/solver.go:60-90); quality drift vs
             # full-every-round is bounded by test and measured in
             # realized_cost.
-            preempt_every=16,
-            preempt_drift=10_000,
+            preempt_every=pov.get("preempt_every", 16),
+            preempt_drift=pov.get("preempt_drift", 10_000),
             # Three-tier stability (VERDICT r4 #2): cadence/drift
             # rounds re-price only residents of machines whose census
             # drifted >= tau (plus the backlog); a truly GLOBAL
@@ -682,8 +690,8 @@ def run_config(args) -> None:
             # window is ~1.5x the measured scoped mover count so
             # nothing parks (docs/NOTES.md round-5: scope-on-any-
             # change + a binding window was a measured catastrophe).
-            preempt_global_every=128,
-            preempt_scope_tau=16,
+            preempt_global_every=pov.get("preempt_global_every", 128),
+            preempt_scope_tau=pov.get("preempt_scope_tau", 16),
             preempt_scoped_width=16_384,
             decode_width=4096,
             label=(
@@ -694,6 +702,8 @@ def run_config(args) -> None:
             ),
             verbose=args.verbose,
         )
+        if pov:
+            out["detail"]["overrides"] = dict(sorted(pov.items()))
     elif name == "whare-hetero":
         from ksched_tpu.costmodels import whare
 
@@ -1166,11 +1176,20 @@ def _gtrace_device_bench(
     # ~75% of 25k slots — the regime where interference pricing does
     # real work (comparable to coco50k's ~78% occupancy).
     slots_per_machine = 8
+    decode_width = 4096
+    task_capacity = 1 << 16 if burst else 1 << 15
     if cost_model:
         slots_per_machine = 2
         rate = 160.0 if platform != "cpu" else 60.0
-    decode_width = 4096
-    task_capacity = 1 << 16 if (burst or cost_model) else 1 << 15
+        # r5 ablation (BENCH_GTRACE_ABLATION_r05): at M=12.5k the
+        # iterative config's cost was machinery, not supersteps —
+        # decode 4096 -> 1024 saves 4.1 ms/round (admissions p50 160 /
+        # max 199 per window; 1024 is 5x headroom) and Tcap 65536 ->
+        # 32768 saves ~2.1 ms of Tcap-wide scans (steady live ~19.2k
+        # at 160/s x 120 s runtimes). 12.48 -> 4.63 ms p50 measured,
+        # identical placed/finished totals.
+        decode_width = 1024
+        task_capacity = 1 << 15
     # --override k=v ablation knobs (round-anatomy forensics — a
     # deviation from the named config is recorded in the metric line)
     ov = {}
@@ -1449,8 +1468,8 @@ def main():
     ap.add_argument("--cpu", action="store_true", help="run host-only on JAX-CPU (skip the accelerator); combine with --backend native/ref for the host solver paths")
     ap.add_argument(
         "--backend",
-        choices=["auto", "device", "layered", "jax", "native", "ref",
-                 "autograph"],
+        choices=["auto", "device", "layered", "jax", "ell", "native",
+                 "ref", "autograph"],
         default="auto",
         help=(
             "scheduling path: device = device-resident cluster (the TPU "

@@ -245,7 +245,7 @@ def main(argv=None) -> int:
         default="trivial",
     )
     ap.add_argument(
-        "--backend", choices=["ref", "native", "jax", "auto"],
+        "--backend", choices=["ref", "native", "jax", "ell", "auto"],
         default="native",
         help="MCMF backend (native C++ is the CPU production default; "
         "auto = per-solve dense-vs-CSR dispatch, solver/graph_collapse.py)",

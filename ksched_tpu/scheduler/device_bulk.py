@@ -750,7 +750,20 @@ class DeviceBulkCluster:
                         # the fallback.
                         if stage1_quarter:
                             s1_eps0 = jnp.maximum(i32(1), i32(n_scale // 4))
-                            s1_budget = 1024
+                            # 2048, not 1024: the multiblock max-tail
+                            # round was a pure budget exhaustion — the
+                            # captured monster needed ~1286 stage-1
+                            # supersteps, got cut at 1024, and paid a
+                            # ~3350-superstep full fallback on top
+                            # (4374 total, ~30 ms; 16-instance r5
+                            # replay sweep, tools/tail_repro.py
+                            # replay-grouped). Typical rounds converge
+                            # far below either bound, so the extra
+                            # headroom costs nothing except on
+                            # instances that would blow BOTH budgets,
+                            # which the capture population does not
+                            # contain.
+                            s1_budget = 2048
                         else:
                             s1_eps0 = i32(1)
                             s1_budget = 256

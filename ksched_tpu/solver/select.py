@@ -30,6 +30,15 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         from .jax_solver import JaxSolver
 
         return JaxSolver(warm_start=warm_start)
+    if name == "ell":
+        # bucketed-ELL layout of the same push-relabel (ell_solver.py):
+        # measured within ~2% of the CSR layout on TPU at 10k x 1k —
+        # both are bound by gather/iteration costs, not the scans —
+        # kept selectable for degree-skewed graphs where the dense
+        # row ops pay off
+        from .ell_solver import EllSolver
+
+        return EllSolver(warm_start=warm_start)
     if name == "ref":
         from .cpu_ref import ReferenceSolver
 
@@ -48,5 +57,6 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
             make_backend("native", warm_start=warm_start, fallback=fallback)
         )
     raise ValueError(
-        f"unknown backend {name!r}; want native | jax | ref | layered | auto"
+        f"unknown backend {name!r}; want native | jax | ell | ref | "
+        "layered | auto"
     )

@@ -27,6 +27,11 @@ def main():
     ap.add_argument("--chunks", type=int, default=3)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--max-supersteps", type=int, default=4096)
+    ap.add_argument(
+        "--layout", choices=("csr", "ell"), default="csr",
+        help="general-solver data layout: sorted-entry CSR "
+        "(jax_solver) or bucketed ELL (ell_solver)",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -40,21 +45,31 @@ def main():
     from jax import lax
 
     import __graft_entry__ as graft
-    from ksched_tpu.solver.jax_solver import _solve_mcmf, build_csr_plan
 
     problem = graft._build_problem()
     n = problem.num_nodes
     src = problem.src.astype(np.int32)
     dst = problem.dst.astype(np.int32)
-    plan = build_csr_plan(src, dst, n)
-    plan_arrays = tuple(
-        jnp.asarray(x)
-        for x in (
-            plan.s_arc, plan.s_sign, plan.s_src, plan.s_dst,
-            plan.s_segstart, plan.s_isstart, plan.inv_order,
-            plan.node_first, plan.node_last, plan.node_nonempty,
+    if args.layout == "ell":
+        from ksched_tpu.solver.ell_solver import (
+            _plan_args,
+            _solve_mcmf_ell as _solve_mcmf,
+            build_ell_plan,
         )
-    )
+
+        plan_arrays = _plan_args(build_ell_plan(src, dst, n))
+    else:
+        from ksched_tpu.solver.jax_solver import _solve_mcmf, build_csr_plan
+
+        plan = build_csr_plan(src, dst, n)
+        plan_arrays = tuple(
+            jnp.asarray(x)
+            for x in (
+                plan.s_arc, plan.s_sign, plan.s_src, plan.s_dst,
+                plan.s_segstart, plan.s_isstart, plan.inv_order,
+                plan.node_first, plan.node_last, plan.node_nonempty,
+            )
+        )
     cap = jnp.asarray(problem.cap.astype(np.int32))
     cost = jnp.asarray(problem.cost.astype(np.int32) * np.int32(n))
     supply = jnp.asarray(problem.excess.astype(np.int32))
@@ -122,7 +137,7 @@ def main():
                     f"p50 cold-solve latency, general CSR cost-scaling "
                     f"push-relabel, 10k tasks x 1k machines graph "
                     f"({n} nodes, {A} arcs), {N}-solve chains, "
-                    f"backend=csr/{platform}"
+                    f"backend={args.layout}/{platform}"
                 ),
                 "value": round(p50, 3),
                 "unit": "ms",

@@ -69,6 +69,92 @@ def _load_model_kappa(suite_json: str, config: str):
     raise SystemExit(f"config {config!r} not found in {suite_json}")
 
 
+def _build_chain_grouped(data, k: int, reps: int, alpha: int, supersteps: int):
+    """Chain for GROUPED captures (quincy/multiblock, tail_repro
+    capture --config multiblock): replicates the production two-stage
+    dispatch — bounded stage-1 discount descent (eps0=n_scale/4,
+    budget 1024, no retry) and, under lax.cond, the refined full
+    fallback when the budget is exhausted — so the measured
+    per-superstep cost covers the same op mix the round pays
+    (scheduler/device_bulk.py grouped dispatch). The cheap stage-2
+    greedy spill is host-side in production and excluded from both
+    the model's kappa and this chain."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ksched_tpu.solver.layered import choose_eps0, transport_fori
+
+    i32 = jnp.int32
+    n_scale = int(data["n_scale"])
+    Mp = int(data["Mp"])
+    e = data["g_e"].astype(np.int64)
+    u = data["g_u"].astype(np.int64)
+    pref = data["g_pref"].astype(np.int64)
+    G, M = pref.shape
+    route = np.broadcast_to(e[:, None], (G, M))
+    w = np.minimum(route, pref) - u[:, None]
+    ground = (e - u).astype(np.int64)
+    supply = data[f"supply_{k}"].astype(np.int32)
+    machine_free = data[f"free_{k}"].astype(np.int32)
+    total = int(supply.sum())
+    active_cap = int(data["active_cap"])
+    act = np.nonzero(supply > 0)[0]
+    if len(act) > active_cap:
+        act = np.arange(G)
+    wA = w[act]
+    supA = supply[act]
+    groundA = ground[act]
+    Ga = len(act)
+    col_cap = np.zeros(Mp, np.int64)
+    col_cap[:M] = machine_free
+    col_cap[-1] = total
+    wP = np.zeros((Ga, Mp), np.int64)
+    wP[:, :M] = wA
+    wS = jnp.asarray((wP * n_scale).astype(np.int32))
+    supJ = jnp.asarray(supA)
+    capJ = jnp.asarray(col_cap.astype(np.int32))
+    eps_full = int(max(1, np.abs(wP).max() * n_scale))
+    D = np.maximum(groundA[:, None] - wA, 0)
+    w1 = np.where(D > 0, -D, 1)
+    w1P = np.zeros((Ga, Mp), np.int64)
+    w1P[:, :M] = w1
+    wS1 = jnp.asarray((w1P * n_scale).astype(np.int32))
+    fb_eps0 = int(choose_eps0(n_scale, eps_full, total,
+                              int(machine_free.sum()), short=n_scale))
+
+    def solve(sup_i):
+        y1, pm1, s1, conv1 = transport_fori(
+            wS1, sup_i, capJ, supersteps, alpha=2, refine_waves=8,
+            eps0=n_scale // 4, eps0_budget=1024, eps0_retry=False,
+        )
+
+        def fallback(_):
+            y2, pm2, s2, _c2 = transport_fori(
+                wS, sup_i, capJ, supersteps, alpha=2, refine_waves=8,
+                eps0=fb_eps0,
+            )
+            return y2, pm2, s1 + s2, _c2
+
+        def done(_):
+            return y1, pm1, s1, conv1
+
+        return lax.cond(conv1, done, fallback, operand=None)
+
+    def chain(_):
+        def body(carry, x):
+            sup_i = supJ.at[0].add(jnp.where(x < i32(0), carry, i32(0)))
+            _y, _pm, steps, conv = solve(sup_i)
+            return carry + steps, (steps, conv)
+
+        total_ss, (ss, conv) = lax.scan(
+            body, i32(0), jnp.arange(reps, dtype=i32)
+        )
+        return total_ss, ss, jnp.all(conv)
+
+    return jax.jit(chain)
+
+
 def build_chain(data, k: int, reps: int, alpha: int, supersteps: int):
     """A jitted `reps`-solve chain of captured instance `k`, matching
     round_core's solve dispatch (scheduler/device_bulk.py:546-563 for
@@ -88,6 +174,8 @@ def build_chain(data, k: int, reps: int, alpha: int, supersteps: int):
     n_scale = int(data["n_scale"])
     Mp = int(data["Mp"])
     preempt = int(data.get("preempt", 0)) == 1
+    if int(data.get("grouped", 0)) == 1:
+        return _build_chain_grouped(data, k, reps, alpha, supersteps)
     w = data[f"w_{k}"].astype(np.int64)
     supply = data[f"supply_{k}"].astype(np.int32)
     col_cap = data[f"colcap_{k}"].astype(np.int32)

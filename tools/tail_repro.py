@@ -392,8 +392,14 @@ def replay_grouped(args) -> None:
             ss_total = 0
             if strat.startswith("two"):
                 # two-stage: stage-1 eps0/budget from the strategy name
-                # two:<eps0>:<budget>  (eps0 'n4' = n_scale/4, '1' = 1)
-                _, e0name, budget = strat.split(":")
+                # two:<eps0>:<budget>[:<fallback-eps0>]
+                # (eps0 'n4' = n_scale/4, '1' = 1; the optional 4th
+                # field overrides the FULL-FALLBACK eps0 taken when the
+                # stage-1 budget is exhausted — the production default
+                # is choose_eps0(short=n_scale))
+                parts = strat.split(":")
+                _, e0name, budget = parts[:3]
+                fb_name = parts[3] if len(parts) > 3 else None
                 e0 = {"1": 1, "n4": n_scale // 4, "n": n_scale}[e0name]
                 y1, _pm, s1, conv1 = transport_fori(
                     wS1, supJ, capJ, 1 << 17, alpha=2, refine_waves=8,
@@ -410,11 +416,16 @@ def replay_grouped(args) -> None:
                     y2 = split_grants_by_class(grants_m, left)
                     y_real = y1r + y2
                 else:
+                    fb = {
+                        None: int(choose_eps0(n_scale, eps_full, total,
+                                              int(machine_free.sum()),
+                                              short=n_scale)),
+                        "n4": n_scale // 4, "n": n_scale,
+                        "n2": n_scale // 2, "1": 1,
+                    }[fb_name]
                     y_f, _pm, s2, conv2 = transport_fori(
                         wS, supJ, capJ, 1 << 17, alpha=2, refine_waves=8,
-                        eps0=int(choose_eps0(n_scale, eps_full, total,
-                                             int(machine_free.sum()),
-                                             short=n_scale)),
+                        eps0=int(fb),
                     )
                     ss_total += int(s2)
                     assert bool(conv2)

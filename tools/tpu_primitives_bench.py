@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Calibrate the TPU primitive costs that bound the general-graph
+(CSR/ELL) solver: random gather, cumsum, associative scan, and dense
+row reductions, at the shapes the 10k x 1k flow graph produces.
+
+Motivation (round 5): the bucketed-ELL rewrite removed every global
+scan from the push-relabel superstep and measured ... no win (59.2 vs
+60.5 ms/solve). Either gathers dominate both layouts, or the cost is
+somewhere else entirely. This tool measures each primitive in an
+isolated data-chained loop so the 60 ms has an arithmetic explanation.
+
+Each measurement chains REPS applications inside one jitted scan with
+a loop-carried dependency (XLA cannot hoist or CSE), closed by the
+scalar-fetch barrier, following docs/NOTES.md measurement discipline.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed_chain(body, state0, reps, label, results):
+    """body(state) -> state with identical structure; chains reps."""
+
+    def chain(s0):
+        def step(s, _):
+            return body(s), ()
+
+        out, _ = lax.scan(step, s0, None, length=reps)
+        return out
+
+    fn = jax.jit(chain)
+    out = fn(state0)
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+    t0 = time.perf_counter()
+    out = fn(state0)
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    per_us = wall_ms * 1e3 / reps
+    results[label] = round(per_us, 2)
+    print(f"  {label:34s} {per_us:9.2f} us/op  (wall {wall_ms:.0f} ms)",
+          file=sys.stderr)
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    rng = np.random.default_rng(0)
+    N = 32768          # nodes
+    E = 131072         # doubled residual entries (CSR layout)
+    ES, W = 32768, 8   # ELL small block
+    results = {}
+    platform = jax.devices()[0].platform
+    print(f"# platform={platform} reps={reps}", file=sys.stderr)
+
+    table = jnp.asarray(rng.integers(0, 100, N).astype(np.int32))
+    idx_e = jnp.asarray(rng.integers(0, N, E).astype(np.int32))
+    idx_ell = jnp.asarray(rng.integers(0, N, (ES, W)).astype(np.int32))
+    vec_e = jnp.asarray(rng.integers(0, 100, E).astype(np.int32))
+    mat = jnp.asarray(rng.integers(0, 100, (ES, W)).astype(np.int32))
+    flags = jnp.asarray(rng.random(E) < 0.25)
+
+    # gather: E random indices into an N-entry table (p[s_src] etc.)
+    def g1_body(s):
+        t, acc = s
+        g = t[idx_e]
+        return t + g[0] * 0, g
+
+    timed_chain(
+        g1_body, (table, jnp.zeros(E, jnp.int32)),
+        reps, f"gather {E} from {N} (flat int32)", results,
+    )
+    # gather in ELL shape: [32768, 8] indices
+    def g2_body(s):
+        t, acc = s
+        g = t[idx_ell]
+        return t + g[0, 0], g
+
+    timed_chain(
+        g2_body, (table, jnp.zeros((ES, W), jnp.int32)),
+        reps, f"gather [{ES},{W}] from {N}", results,
+    )
+    # cumsum over E
+    def cs_body(s):
+        v, acc = s
+        c = jnp.cumsum(v)
+        return v + c[-1] * 0 + acc[0] * 0, c
+
+    timed_chain(
+        cs_body, (vec_e, jnp.zeros(E, jnp.int32)),
+        reps, f"cumsum {E} (int32)", results,
+    )
+    # segmented max via associative scan over E (the CSR relabel)
+    def as_body(s):
+        v, acc = s
+
+        def combine(a, b):
+            f1, v1 = a
+            f2, v2 = b
+            return f1 | f2, jnp.where(f2, v2, jnp.maximum(v1, v2))
+
+        _, scanned = lax.associative_scan(combine, (flags, v))
+        return v + scanned[-1] * 0 + acc[0] * 0, scanned
+
+    timed_chain(
+        as_body, (vec_e, jnp.zeros(E, jnp.int32)),
+        reps, f"assoc-scan segmax {E}", results,
+    )
+    # dense row reduce [32768, 8] -> [32768] (the ELL per-node combine)
+    def rr_body(s):
+        m, acc = s
+        r = jnp.sum(m, axis=1)
+        return m + r[0] * 0 + acc[0] * 0, r
+
+    timed_chain(
+        rr_body, (mat, jnp.zeros(ES, jnp.int32)),
+        reps, f"row-sum [{ES},{W}]", results,
+    )
+    # elementwise pass over E (the floor: one fused map)
+    def ew_body(s):
+        v, acc = s
+        return v * 3 + 1 + acc[0] * 0, v
+
+    timed_chain(
+        ew_body, (vec_e, jnp.zeros(E, jnp.int32)),
+        reps, f"elementwise {E}", results,
+    )
+    print(json.dumps({"platform": platform, "reps": reps,
+                      "per_op_us": results}))
+
+
+if __name__ == "__main__":
+    main()
