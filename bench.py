@@ -235,6 +235,7 @@ def _device_bench(
     preempt_global_every: int = 0,
     preempt_scope_tau: int = 1,
     preempt_scoped_width=None,
+    preempt_incr_budget=None,
     label: str = "trivial cost model",
     verbose: bool = False,
 ) -> dict:
@@ -282,6 +283,7 @@ def _device_bench(
         preempt_global_every=preempt_global_every,
         preempt_scope_tau=preempt_scope_tau,
         preempt_scoped_width=preempt_scoped_width,
+        preempt_incr_budget=preempt_incr_budget,
     )
     devices = jax.devices()
     churn_n = max(1, int(tasks * churn))
@@ -404,7 +406,7 @@ def _device_bench(
             file=sys.stderr,
         )
     ss_all, full_all, glob_all, placed_all, live_last = [], [], [], [], 0
-    drift_all = []
+    drift_all, esc_all = [], []
     for rep, stats in enumerate(chunk_stats):
         got = dev.fetch_stats(stats)
         assert got["converged"].all(), "a steady round did not converge"
@@ -417,6 +419,8 @@ def _device_bench(
             glob_all.append(np.asarray(got["global_round"]))
         if "census_drift" in got:
             drift_all.append(np.asarray(got["census_drift"]))
+        if "escalated_round" in got:
+            esc_all.append(np.asarray(got["escalated_round"]))
         placed_all.append(np.asarray(got["placed"]))
         live_last = int(got["live"][-1])
         if verbose:
@@ -460,6 +464,7 @@ def _device_bench(
         fcat_t = np.concatenate(full_all).astype(bool) if full_all else None
         gcat_t = np.concatenate(glob_all).astype(bool) if glob_all else None
         dcat_t = np.concatenate(drift_all) if drift_all else None
+        ecat_t = np.concatenate(esc_all).astype(bool) if esc_all else None
         detail["top_rounds"] = [
             {
                 "round": int(i),
@@ -467,7 +472,10 @@ def _device_bench(
                 **(
                     {
                         "tier": (
-                            "global" if gcat_t is not None and gcat_t[i]
+                            "escalated"
+                            if ecat_t is not None and ecat_t[i]
+                            else "global"
+                            if gcat_t is not None and gcat_t[i]
                             else "scoped" if fcat_t[i] else "incremental"
                         )
                     }
@@ -480,6 +488,8 @@ def _device_bench(
             }
             for i in top
         ]
+        if esc_all:
+            detail["escalated_rounds"] = int(np.concatenate(esc_all).sum())
         if glob_all and preempt_global_every > 0:
             detail["global_rounds"] = int(np.concatenate(glob_all).sum())
             # scoped-regime evidence: the p99 claim rests on scoped
@@ -652,7 +662,8 @@ def run_config(args) -> None:
             k, _, v = kv.partition("=")
             pov[k] = int(v)
         unknown = set(pov) - {"preempt_drift", "preempt_every",
-                              "preempt_global_every", "preempt_scope_tau"}
+                              "preempt_global_every", "preempt_scope_tau",
+                              "preempt_incr_budget"}
         if unknown:
             raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
         penalties = rng.integers(0, 40, (1_000, 4)).astype(np.int64)
@@ -692,13 +703,20 @@ def run_config(args) -> None:
             # change + a binding window was a measured catastrophe).
             preempt_global_every=pov.get("preempt_global_every", 128),
             preempt_scope_tau=pov.get("preempt_scope_tau", 16),
+            # bound the incremental-round solve; a non-converged
+            # attempt escalates to the scoped tier (the measured incr
+            # monsters — 42.7k and 62.3k supersteps — become
+            # budget + scoped-cost rounds by construction)
+            preempt_incr_budget=(
+                pov.get("preempt_incr_budget", 8192) or None  # 0 = off
+            ),
             preempt_scoped_width=16_384,
             decode_width=4096,
             label=(
                 "CoCo interference cost model (4 classes), preemption ON "
-                "(three-tier: incremental rounds + scoped re-solve over "
-                "drifted columns every 16 or on census drift + global "
-                "re-solve every 128)"
+                "(three-tier: budgeted incremental rounds escalating to "
+                "scoped re-solves over drifted columns every 16 or on "
+                "census drift + global re-solve every 128)"
             ),
             verbose=args.verbose,
         )

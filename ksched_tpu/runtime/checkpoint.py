@@ -237,6 +237,7 @@ def save_device_checkpoint(cluster, path: str) -> None:
         "preempt_global_every": cluster.preempt_global_every,
         "preempt_scope_tau": cluster.preempt_scope_tau,
         "preempt_scoped_width": cluster.preempt_scoped_width,
+        "preempt_incr_budget": cluster.preempt_incr_budget,
         "track_realized_cost": int(cluster.track_realized_cost),
         "num_groups": cluster.G if cluster.grouped else 0,
         # the full compaction ladder (a JSON list; int in pre-r4 saves)
@@ -332,6 +333,7 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
             or meta["preempt_scoped_width"] < 0
             else meta["preempt_scoped_width"]
         ),
+        preempt_incr_budget=meta.get("preempt_incr_budget"),
         track_realized_cost=bool(meta.get("track_realized_cost", 0)),
         num_groups=meta["num_groups"],
         active_groups_cap=meta["active_groups_cap"],

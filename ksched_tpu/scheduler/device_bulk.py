@@ -113,6 +113,7 @@ class DeviceBulkCluster:
         preempt_global_every: int = 0,
         preempt_scope_tau: int = 1,
         preempt_scoped_width: Optional[int] = None,
+        preempt_incr_budget: Optional[int] = None,
         track_realized_cost: bool = False,
         num_groups: int = 0,
         active_groups_cap: int = 256,
@@ -248,6 +249,21 @@ class DeviceBulkCluster:
             None if preempt_scoped_width is None
             else int(preempt_scoped_width)
         )
+        # Incremental-round superstep budget (three-tier only): the
+        # backlog-admission solve of an incremental round occasionally
+        # hits the eps-slosh regime against drifted census costs —
+        # measured monsters of 42.7k and 62.3k supersteps (~1-in-40k
+        # rounds, top_rounds forensics r5; drift value at the monster
+        # is 4-10k, i.e. NOT predicted by the drift trigger, and
+        # lowering the trigger measured WORSE). With a budget set, the
+        # incremental attempt is bounded and a non-converged attempt
+        # ESCALATES the round to the scoped tier (discarding the
+        # attempt, re-pricing the drifted columns) — the incremental
+        # tail becomes min(monster, budget + scoped-round cost) by
+        # construction. None disables (bit-identical legacy rounds).
+        self.preempt_incr_budget = (
+            None if preempt_incr_budget is None else int(preempt_incr_budget)
+        )
         if self.preempt_every < 1:
             raise ValueError("preempt_every must be >= 1")
         if self.preempt_drift < 0:
@@ -264,6 +280,15 @@ class DeviceBulkCluster:
                 "preempt_global_every requires stability-aware "
                 "preemption (preempt_every > 1 or preempt_drift > 0)"
             )
+        if self.preempt_incr_budget is not None:
+            if self.preempt_incr_budget < 1:
+                raise ValueError("preempt_incr_budget must be >= 1")
+            if self.preempt_global_every <= 0:
+                raise ValueError(
+                    "preempt_incr_budget requires the three-tier scheme "
+                    "(preempt_global_every > 0) — escalation targets the "
+                    "scoped tier"
+                )
         # Opt-in quality metric: pricing the whole assignment costs an
         # extra cost_fn + Tcap gather per round INSIDE the timed scan —
         # the parity tests turn it on; benches leave it off so the
@@ -404,6 +429,7 @@ class DeviceBulkCluster:
         global_every = self.preempt_global_every
         scope_tau = self.preempt_scope_tau
         scoped_width = self.preempt_scoped_width
+        incr_budget = self.preempt_incr_budget
         track_realized = self.track_realized_cost
         refine_waves = self.refine_waves
         # Per-row (group) escape costs: row g = j*C + c escapes at job
@@ -549,7 +575,8 @@ class DeviceBulkCluster:
             return cost_eff, w
 
         def round_core(state: DeviceClusterState, gspec=None,
-                       decode_width=None, window_offset=None):
+                       decode_width=None, window_offset=None,
+                       supersteps_cap=None):
             """One scheduling round. decode_width (static) bounds the
             decode to a compacted window of that many unplaced rows —
             the admission-batch bound (the reference bounds per-round
@@ -561,7 +588,18 @@ class DeviceBulkCluster:
             the window forever and starve placeable tasks behind them.
             With decode_width=None the decode spans all Tcap rows (the
             fill path). Bounding matters at 50k+ tasks: the decode's
-            [width, M] passes dominate the non-solve round cost."""
+            [width, M] passes dominate the non-solve round cost.
+
+            supersteps_cap (static) bounds this round's transport
+            budget below the cluster-wide `supersteps` safety bound;
+            a capped solve may return converged=False, which the
+            three-tier hybrid uses as its escalation signal (the
+            caller discards the attempt)."""
+            ss_budget = (
+                supersteps
+                if supersteps_cap is None
+                else min(int(supersteps_cap), supersteps)
+            )
             pu_free = jnp.where(
                 jnp.repeat(state.machine_enabled, P),
                 S - state.pu_running,
@@ -668,7 +706,7 @@ class DeviceBulkCluster:
                 # slots) switch to the full-range start — choose_eps0.
                 eps_full = jnp.maximum(jnp.max(jnp.abs(wS)), i32(1))
                 y, _pm, solve_steps, converged = transport_fori(
-                    wS, supply, col_cap, supersteps,
+                    wS, supply, col_cap, ss_budget,
                     alpha=alpha,
                     eps0=choose_eps0(
                         n_scale, eps_full, total, jnp.sum(machine_free)
@@ -724,7 +762,7 @@ class DeviceBulkCluster:
                         # price-war steps (tools/tail_repro.py
                         # replay-grouped).
                         y_f, _pmf, s_f, c_f = transport_fori(
-                            wS_x, supply_x, col_cap, supersteps,
+                            wS_x, supply_x, col_cap, ss_budget,
                             alpha=2, refine_waves=8,
                             eps0=choose_eps0(
                                 n_scale, eps_full_x, total_x,
@@ -768,7 +806,7 @@ class DeviceBulkCluster:
                             s1_eps0 = i32(1)
                             s1_budget = 256
                         y1, _pm1, s1, conv1 = transport_fori(
-                            wS1_x, supply_x, col_cap, supersteps,
+                            wS1_x, supply_x, col_cap, ss_budget,
                             alpha=2, refine_waves=8,
                             eps0=s1_eps0, eps0_budget=s1_budget,
                             eps0_retry=False,
@@ -1246,34 +1284,73 @@ class DeviceBulkCluster:
                     state, gspec,
                     decode_width=steady_decode_width,
                     window_offset=window_offset,
+                    supersteps_cap=incr_budget,
                 )
                 st = dict(st)
                 st.pop("active_groups", None)  # preempt core has none
                 st["migrated"] = i32(0)
                 st["preempted"] = i32(0)
-                return s2, census_ref, st
+                st["escalated"] = jnp.bool_(False)
+                if incr_budget is None:
+                    return s2, census_ref, st
+
+                # Escalation (three-tier only, enforced in __init__): a
+                # budget-exhausted incremental attempt is DISCARDED and
+                # the round re-runs as a scoped re-solve from the same
+                # pre-round state — re-pricing the drifted columns is
+                # exactly what the sloshing admission solve was missing.
+                # The attempt's supersteps stay in the round's count
+                # (real work the round paid for).
+                def keep(_):
+                    return s2, census_ref, st
+
+                def escalate(_):
+                    s3, cen3, st3 = scoped_branch(None)
+                    st3 = dict(st3)
+                    st3["escalated"] = jnp.bool_(True)
+                    st3["supersteps"] = st3["supersteps"] + st["supersteps"]
+                    return s3, cen3, st3
+
+                return lax.cond(st["converged"], keep, escalate, operand=None)
 
             if global_every > 0:
                 def resolve_branch(_):
-                    return lax.cond(
+                    s2, cen2, st = lax.cond(
                         do_global, full_branch, scoped_branch, operand=None
                     )
+                    st = dict(st)
+                    st["escalated"] = jnp.bool_(False)
+                    return s2, cen2, st
 
                 state2, census_ref2, stats = lax.cond(
                     do_full | do_global, resolve_branch, incr_branch,
                     operand=None,
                 )
-                fired = do_full | do_global
+                stats = dict(stats)
+                escalated = stats.pop("escalated")
+                # an escalated round IS a fired (scoped) round: census
+                # re-based, cadence counter reset, scope forensics
+                # attribute it to the scoped tier
+                fired = do_full | do_global | escalated
                 kg_since2 = jnp.where(do_global, i32(0), kg_since + 1)
             else:
+                def full_branch_tagged(_):
+                    s2, cen2, st = full_branch(None)
+                    st = dict(st)
+                    st["escalated"] = jnp.bool_(False)
+                    return s2, cen2, st
+
                 state2, census_ref2, stats = lax.cond(
-                    do_full, full_branch, incr_branch, operand=None
+                    do_full, full_branch_tagged, incr_branch, operand=None
                 )
+                stats = dict(stats)
+                escalated = stats.pop("escalated")
                 fired = do_full
                 kg_since2 = kg_since
             k_since2 = jnp.where(fired, i32(0), k_since + 1)
             stats["full_round"] = fired
             stats["global_round"] = do_global if global_every > 0 else fired
+            stats["escalated_round"] = escalated
             stats["census_drift"] = drift
             if track_realized:
                 stats["realized_cost"] = realized_cluster_cost(state2, gspec)

@@ -190,6 +190,19 @@ def build_ell_plan(
     )
 
 
+
+def _g2(table, idx2):
+    """2D-indexed gather via flat gather + optimization_barrier +
+    reshape. The direct form `table[idx2]` lowers pathologically on
+    TPU: measured 2011 us for a [32768, 8] int32 gather vs 120 us for
+    the same 262k elements gathered flat (tools/tpu_primitives_bench).
+    XLA fuses a bare reshape back into the 2D gather (2002 us); the
+    barrier blocks that fusion and keeps the fast flat lowering
+    (151 us, 13x). Semantically identical."""
+    g = table[idx2.reshape(-1)]
+    g = jax.lax.optimization_barrier(g)
+    return g.reshape(idx2.shape)
+
 @functools.partial(
     jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps")
 )
@@ -207,10 +220,10 @@ def _solve_mcmf_ell(
     kmax = hub_rows.shape[1]
 
     # entry-block constants (costs/caps don't change during a solve)
-    sc_s = s_sign * cost[s_arc]  # signed cost per small entry
-    sc_h = h_sign * cost[h_arc]
-    cap_s = cap[s_arc]
-    cap_h = cap[h_arc]
+    sc_s = s_sign * _g2(cost, s_arc)  # signed cost per small entry
+    sc_h = h_sign * _g2(cost, h_arc)
+    cap_s = _g2(cap, s_arc)
+    cap_h = _g2(cap, h_arc)
 
     def per_node(part_s, part_h_row, combine, identity):
         """Assemble a per-node [N] value from block partials by gather.
@@ -227,19 +240,21 @@ def _solve_mcmf_ell(
         return jnp.where(node_kind == 0, identity, v)
 
     def residuals(flow):
+        f_s = _g2(flow, s_arc)
+        f_h = _g2(flow, h_arc)
         r_s = jnp.where(
-            s_sign > 0, cap_s - flow[s_arc],
-            jnp.where(s_sign < 0, flow[s_arc], i32(0)),
+            s_sign > 0, cap_s - f_s,
+            jnp.where(s_sign < 0, f_s, i32(0)),
         )
         r_h = jnp.where(
-            h_sign > 0, cap_h - flow[h_arc],
-            jnp.where(h_sign < 0, flow[h_arc], i32(0)),
+            h_sign > 0, cap_h - f_h,
+            jnp.where(h_sign < 0, f_h, i32(0)),
         )
         return r_s, r_h
 
     def excess_of(flow):
-        out_s = jnp.sum(s_sign * flow[s_arc], axis=1)
-        out_h = jnp.sum(h_sign * flow[h_arc], axis=1)
+        out_s = jnp.sum(s_sign * _g2(flow, s_arc), axis=1)
+        out_h = jnp.sum(h_sign * _g2(flow, h_arc), axis=1)
         return supply - per_node(out_s, out_h, jnp.sum, i32(0))
 
     def saturate(flow, p):
@@ -257,8 +272,8 @@ def _solve_mcmf_ell(
 
         def t_body(state):
             d, _, it = state
-            cand_s = jnp.where(r_s > 0, sc_s + d[s_peer], i32(_BIG_D))
-            cand_h = jnp.where(r_h > 0, sc_h + d[h_peer], i32(_BIG_D))
+            cand_s = jnp.where(r_s > 0, sc_s + _g2(d, s_peer), i32(_BIG_D))
+            cand_h = jnp.where(r_h > 0, sc_h + _g2(d, h_peer), i32(_BIG_D))
             best = per_node(
                 jnp.min(cand_s, axis=1), jnp.min(cand_h, axis=1),
                 jnp.min, i32(_BIG_D),
@@ -271,8 +286,10 @@ def _solve_mcmf_ell(
 
     def superstep(flow, p, eps, excess):
         r_s, r_h = residuals(flow)
-        rc_s = sc_s + p[s_node][:, None] - p[s_peer]
-        rc_h = sc_h + p[h_node][:, None] - p[h_peer]
+        pp_s = _g2(p, s_peer)
+        pp_h = _g2(p, h_peer)
+        rc_s = sc_s + p[s_node][:, None] - pp_s
+        rc_h = sc_h + p[h_node][:, None] - pp_h
         e_s = excess[s_node]
         e_h = excess[h_node]
         adm_s = (r_s > 0) & (rc_s < 0) & (e_s[:, None] > 0)
@@ -303,8 +320,8 @@ def _solve_mcmf_ell(
         sum_r = per_node(
             jnp.sum(r_s, axis=1), jnp.sum(r_h, axis=1), jnp.sum, i32(0)
         )
-        cand_s = jnp.where(r_s > 0, p[s_peer] - sc_s, -_BIG)
-        cand_h = jnp.where(r_h > 0, p[h_peer] - sc_h, -_BIG)
+        cand_s = jnp.where(r_s > 0, pp_s - sc_s, -_BIG)
+        cand_h = jnp.where(r_h > 0, pp_h - sc_h, -_BIG)
         best = per_node(
             jnp.max(cand_s, axis=1), jnp.max(cand_h, axis=1),
             jnp.max, -_BIG,

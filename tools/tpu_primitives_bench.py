@@ -90,6 +90,43 @@ def main():
         g2_body, (table, jnp.zeros((ES, W), jnp.int32)),
         reps, f"gather [{ES},{W}] from {N}", results,
     )
+    # the same [32768, 8] gather expressed as flat-gather + reshape —
+    # measures whether the 2D-index lowering (1952 us measured) is a
+    # shape artifact the solver can route around
+    def g3_body(s):
+        t, acc = s
+        g = t[idx_ell.reshape(-1)].reshape(ES, W)
+        return t + g[0, 0], g
+
+    timed_chain(
+        g3_body, (table, jnp.zeros((ES, W), jnp.int32)),
+        reps, f"gather [{ES},{W}] via flat+reshape", results,
+    )
+    # pure flat gather at the SAME element count (2*E) — is the 2D
+    # cost a per-element truth or a lowering artifact?
+    idx_flat2 = jnp.asarray(rng.integers(0, N, ES * W).astype(np.int32))
+
+    def g4_body(s):
+        t, acc = s
+        g = t[idx_flat2]
+        return t + g[0] * 0, g
+
+    timed_chain(
+        g4_body, (table, jnp.zeros(ES * W, jnp.int32)),
+        reps, f"gather {ES * W} flat", results,
+    )
+    # flat gather + optimization_barrier + reshape: blocks XLA from
+    # fusing the reshape back into a 2D-indexed gather
+    def g5_body(s):
+        t, acc = s
+        g = t[idx_ell.reshape(-1)]
+        g = jax.lax.optimization_barrier(g)
+        return t + g[0] * 0, g.reshape(ES, W)
+
+    timed_chain(
+        g5_body, (table, jnp.zeros((ES, W), jnp.int32)),
+        reps, f"gather [{ES},{W}] flat+barrier+reshape", results,
+    )
     # cumsum over E
     def cs_body(s):
         v, acc = s
