@@ -529,6 +529,22 @@ def _device_bench(
     }
 
 
+
+def parse_overrides(pairs, allowed):
+    """--override K=V pairs -> dict with int/float coercion; rejects
+    unknown keys so a typo'd ablation cannot silently no-op."""
+    ov = {}
+    for kv in pairs or []:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            raise SystemExit(f"--override wants K=V, got {kv!r}")
+        ov[k] = float(v) if "." in v else int(v)
+    unknown = set(ov) - set(allowed)
+    if unknown:
+        raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
+    return ov
+
+
 def run_device_bench(args) -> None:
     out = _device_bench(
         tasks=args.tasks,
@@ -666,15 +682,10 @@ def run_config(args) -> None:
     elif name == "coco50k-preempt":
         from ksched_tpu.costmodels import coco
 
-        pov = {}
-        for kv in args.override or []:
-            k, _, v = kv.partition("=")
-            pov[k] = int(v)
-        unknown = set(pov) - {"preempt_drift", "preempt_every",
-                              "preempt_global_every", "preempt_scope_tau",
-                              "preempt_incr_budget"}
-        if unknown:
-            raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
+        pov = parse_overrides(args.override, (
+            "preempt_drift", "preempt_every", "preempt_global_every",
+            "preempt_scope_tau", "preempt_incr_budget",
+        ))
         penalties = rng.integers(0, 40, (1_000, 4)).astype(np.int64)
         out = _device_bench(
             tasks=50_000, machines=1_000, pus=4, slots=16, jobs=20,
@@ -716,8 +727,14 @@ def run_config(args) -> None:
             # attempt escalates to the scoped tier (the measured incr
             # monsters — 42.7k and 62.3k supersteps — become
             # budget + scoped-cost rounds by construction)
+            # 0 = off; the default follows the global tier — a two-tier
+            # ablation (--override preempt_global_every=0) has no scoped
+            # tier to escalate to
             preempt_incr_budget=(
-                pov.get("preempt_incr_budget", 8192) or None  # 0 = off
+                pov.get(
+                    "preempt_incr_budget",
+                    8192 if pov.get("preempt_global_every", 128) > 0 else 0,
+                ) or None
             ),
             preempt_scoped_width=16_384,
             decode_width=4096,
@@ -1205,6 +1222,12 @@ def _gtrace_device_bench(
     slots_per_machine = 8
     decode_width = 4096
     task_capacity = 1 << 16 if burst else 1 << 15
+    if burst:
+        # r5 paired A/B/A (same-hour, identical workload totals):
+        # decode 4096 -> 2048 measures 9.61/6.78/7.36 ms — the burst
+        # spikes admit at most 527/window, so 2048 keeps 4x headroom
+        # and halves the [width, M] mover-ranking passes
+        decode_width = 2048
     if cost_model:
         slots_per_machine = 2
         rate = 160.0 if platform != "cpu" else 60.0
@@ -1219,10 +1242,10 @@ def _gtrace_device_bench(
         task_capacity = 1 << 15
     # --override k=v ablation knobs (round-anatomy forensics — a
     # deviation from the named config is recorded in the metric line)
-    ov = {}
-    for kv in overrides or []:
-        k, _, v = kv.partition("=")
-        ov[k] = float(v) if "." in v else int(v)
+    ov = parse_overrides(overrides, (
+        "n_machines", "rate", "slots_per_machine", "decode_width",
+        "task_capacity", "n_windows",
+    ))
     n_machines = int(ov.get("n_machines", n_machines))
     rate = float(ov.get("rate", rate))
     slots_per_machine = int(ov.get("slots_per_machine", slots_per_machine))
@@ -1230,12 +1253,6 @@ def _gtrace_device_bench(
     task_capacity = int(ov.get("task_capacity", task_capacity))
     if "n_windows" in ov:
         n_windows = int(ov["n_windows"])
-    unknown = set(ov) - {
-        "n_machines", "rate", "slots_per_machine", "decode_width",
-        "task_capacity", "n_windows",
-    }
-    if unknown:
-        raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
     duration_s = n_windows * window_s
     num_tasks = int(duration_s * rate)
     burst_kw = {}

@@ -192,16 +192,13 @@ def build_ell_plan(
 
 
 def _g2(table, idx2):
-    """2D-indexed gather via flat gather + optimization_barrier +
-    reshape. The direct form `table[idx2]` lowers pathologically on
-    TPU: measured 2011 us for a [32768, 8] int32 gather vs 120 us for
-    the same 262k elements gathered flat (tools/tpu_primitives_bench).
-    XLA fuses a bare reshape back into the 2D gather (2002 us); the
-    barrier blocks that fusion and keeps the fast flat lowering
-    (151 us, 13x). Semantically identical."""
-    g = table[idx2.reshape(-1)]
-    g = jax.lax.optimization_barrier(g)
-    return g.reshape(idx2.shape)
+    """2D-indexed gather. Measured equivalent to a flat gather of the
+    same element count on TPU (~2.0 ms per 262k int32 elements, i.e.
+    ~7.6 ns/element — tools/tpu_primitives_bench.py with REAL carried
+    dependencies; an earlier flat+optimization_barrier+reshape variant
+    that appeared 13x faster was a dead-code artifact). Kept as a
+    helper so the gather cost model has one grep-able seam."""
+    return table[idx2]
 
 @functools.partial(
     jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps")

@@ -719,16 +719,17 @@ def test_incr_budget_escalates_to_scoped_parity():
     sb = b.fetch_stats(b.run_steady_rounds(6, 0.05, 12, seed=5))
     esc = np.asarray(sa["escalated_round"])
     fb = np.asarray(sb["full_round"])
-    # every A round with solver work escalated; B fires scoped on drift
-    assert esc.any(), "no escalation at budget=1"
-    # rounds escalate exactly when the bounded attempt could not finish;
-    # on those rounds A's state transition equals B's scoped round IF B
-    # also fired — compare end states where the schedules agree
-    if esc.all() and fb.all():
-        for k, v in a.fetch_state().items():
-            assert np.array_equal(
-                np.asarray(v), np.asarray(b.fetch_state()[k])
-            ), k
+    # the contended 600-task/40-machine cluster has churn backlog every
+    # round, so every A round's 1-superstep attempt fails (escalates)
+    # and every B round sees census drift >= 1 (fires scoped) — assert
+    # the preconditions so the parity check below can never silently
+    # skip (review finding r5)
+    assert esc.all(), f"expected every round to escalate, got {esc}"
+    assert fb.all(), f"expected every twin round to fire scoped, got {fb}"
+    for k, v in a.fetch_state().items():
+        assert np.array_equal(
+            np.asarray(v), np.asarray(b.fetch_state()[k])
+        ), k
     # escalated rounds are fired rounds: cadence reset + census re-base
     assert np.asarray(sa["full_round"])[esc].all()
     # and the round still converged (via the scoped solve)

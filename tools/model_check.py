@@ -73,7 +73,7 @@ def _build_chain_grouped(data, k: int, reps: int, alpha: int, supersteps: int):
     """Chain for GROUPED captures (quincy/multiblock, tail_repro
     capture --config multiblock): replicates the production two-stage
     dispatch — bounded stage-1 discount descent (eps0=n_scale/4,
-    budget 1024, no retry) and, under lax.cond, the refined full
+    budget S1_BUDGET, no retry) and, under lax.cond, the refined full
     fallback when the budget is exhausted — so the measured
     per-superstep cost covers the same op mix the round pays
     (scheduler/device_bulk.py grouped dispatch). The cheap stage-2
@@ -122,11 +122,30 @@ def _build_chain_grouped(data, k: int, reps: int, alpha: int, supersteps: int):
     wS1 = jnp.asarray((w1P * n_scale).astype(np.int32))
     fb_eps0 = int(choose_eps0(n_scale, eps_full, total,
                               int(machine_free.sum()), short=n_scale))
+    # production eligibility for the two-stage decomposition
+    # (can_two_stage + the runtime guards in device_bulk's
+    # grouped_solve): ineligible instances go straight to the refined
+    # full solve, so the chain times the op mix the round actually pays
+    two_stage_ok = (total <= int(machine_free.sum())) and bool(
+        ((groundA < 0) | (supA == 0)).all()
+    )
+    #: stage-1 budget — MUST track device_bulk's stage1_quarter budget
+    #: (2048 since r5; was 1024) or the chain re-pays fallbacks
+    #: production no longer takes
+    S1_BUDGET = 2048
+
+    def solve_full_only(sup_i):
+        return transport_fori(
+            wS, sup_i, capJ, supersteps, alpha=2, refine_waves=8,
+            eps0=fb_eps0,
+        )
 
     def solve(sup_i):
+        if not two_stage_ok:
+            return solve_full_only(sup_i)
         y1, pm1, s1, conv1 = transport_fori(
             wS1, sup_i, capJ, supersteps, alpha=2, refine_waves=8,
-            eps0=n_scale // 4, eps0_budget=1024, eps0_retry=False,
+            eps0=n_scale // 4, eps0_budget=S1_BUDGET, eps0_retry=False,
         )
 
         def fallback(_):

@@ -10,8 +10,13 @@ somewhere else entirely. This tool measures each primitive in an
 isolated data-chained loop so the 60 ms has an arithmetic explanation.
 
 Each measurement chains REPS applications inside one jitted scan with
-a loop-carried dependency (XLA cannot hoist or CSE), closed by the
-scalar-fetch barrier, following docs/NOTES.md measurement discipline.
+a REAL loop-carried dependency — the measured op's result feeds the
+next iteration's operand through arithmetic XLA cannot fold away (an
+earlier revision used `result * 0`, which the algebraic simplifier
+folds to 0, turning the timed op loop-invariant and hoistable — the
+gather/cumsum/scan rows measured launch floor, not the op). Closed by
+the scalar-fetch barrier, following docs/NOTES.md measurement
+discipline.
 """
 
 import json
@@ -74,7 +79,7 @@ def main():
     def g1_body(s):
         t, acc = s
         g = t[idx_e]
-        return t + g[0] * 0, g
+        return t + g[0], g
 
     timed_chain(
         g1_body, (table, jnp.zeros(E, jnp.int32)),
@@ -109,7 +114,7 @@ def main():
     def g4_body(s):
         t, acc = s
         g = t[idx_flat2]
-        return t + g[0] * 0, g
+        return t + g[0], g
 
     timed_chain(
         g4_body, (table, jnp.zeros(ES * W, jnp.int32)),
@@ -121,7 +126,7 @@ def main():
         t, acc = s
         g = t[idx_ell.reshape(-1)]
         g = jax.lax.optimization_barrier(g)
-        return t + g[0] * 0, g.reshape(ES, W)
+        return t + g[0], g.reshape(ES, W)
 
     timed_chain(
         g5_body, (table, jnp.zeros((ES, W), jnp.int32)),
@@ -131,7 +136,7 @@ def main():
     def cs_body(s):
         v, acc = s
         c = jnp.cumsum(v)
-        return v + c[-1] * 0 + acc[0] * 0, c
+        return v + c[0], c
 
     timed_chain(
         cs_body, (vec_e, jnp.zeros(E, jnp.int32)),
@@ -147,7 +152,7 @@ def main():
             return f1 | f2, jnp.where(f2, v2, jnp.maximum(v1, v2))
 
         _, scanned = lax.associative_scan(combine, (flags, v))
-        return v + scanned[-1] * 0 + acc[0] * 0, scanned
+        return v + scanned[0], scanned
 
     timed_chain(
         as_body, (vec_e, jnp.zeros(E, jnp.int32)),
@@ -157,7 +162,7 @@ def main():
     def rr_body(s):
         m, acc = s
         r = jnp.sum(m, axis=1)
-        return m + r[0] * 0 + acc[0] * 0, r
+        return m + r[0], r
 
     timed_chain(
         rr_body, (mat, jnp.zeros(ES, jnp.int32)),
@@ -166,7 +171,8 @@ def main():
     # elementwise pass over E (the floor: one fused map)
     def ew_body(s):
         v, acc = s
-        return v * 3 + 1 + acc[0] * 0, v
+        v2 = v * 3 + 1
+        return v2 - v2[0] // 2, v2
 
     timed_chain(
         ew_body, (vec_e, jnp.zeros(E, jnp.int32)),
