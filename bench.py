@@ -1210,7 +1210,7 @@ def _gtrace_device_bench(
             # backend pays them serially; fewer windows keep CI honest
             n_windows, K0 = 32, 8
     else:
-        n_machines, window_s, n_windows, rate = 12_500, 1.0, 8192, 100.0
+        n_machines, window_s, n_windows, rate = 12_500, 1.0, 12_288, 100.0
         K0, chunks_wanted = 512, 3
         min_wall_ms = MIN_CHUNK_WALL_MS
     # the census-priced variant must be CONTENDED to be meaningful: at
@@ -1330,7 +1330,11 @@ def _gtrace_device_bench(
     # warm chunk: compile + advance into the steady regime
     wall, _ = timed_chunk(i0, K, seed=1)
     i0 += K
-    while min_wall_ms and wall < 2 * min_wall_ms and i0 + (chunks_wanted + 1) * 2 * K <= total:
+    # 3x margin, not 2x: the replay configs carry ~2x ambient variance
+    # on the shared host (docs/NOTES.md) — a warm chunk at 2.1x the bar
+    # can be followed by timed chunks UNDER it when the ambient load
+    # lifts mid-run (measured: 4.1 s warm, 1.97 s chunk 3)
+    while min_wall_ms and wall < 3 * min_wall_ms and i0 + (chunks_wanted + 1) * 2 * K <= total:
         K *= 2
         wall, _ = timed_chunk(i0, K, seed=1)  # recompile at the new K
         i0 += K
@@ -1340,6 +1344,16 @@ def _gtrace_device_bench(
         wall, stats = timed_chunk(i0, K, seed=2 + len(chunk_walls))
         i0 += K
         if wall < min_wall_ms:
+            # a chunk dipped under the bar mid-measurement (ambient
+            # lift): grow K and restart the measured set if the staged
+            # stream has room, else fail honestly
+            if i0 + (chunks_wanted + 1) * 2 * K <= total:
+                K *= 2
+                wall, _ = timed_chunk(i0, K, seed=1)  # recompile+warm
+                i0 += K
+                chunk_walls, chunk_stats = [], []
+                timed_lo = i0
+                continue
             raise RuntimeError(
                 f"gtrace chunk wall {wall:.1f} ms under the "
                 f"{min_wall_ms:.0f} ms bar at K={K} with no windows left "
