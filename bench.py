@@ -675,7 +675,12 @@ def run_config(args) -> None:
             unsched_cost=coco.UNSCHEDULED_COST,
             ec_cost=0,
             supersteps=1 << 17,
-            decode_width=4096,
+            # 1024, was 4096: the r5 anatomy probe (tools/coco_anatomy)
+            # measured the decode at 0.166 ms per 1024 width; churn is
+            # 500/round and steady backlog ~0 at 78% occupancy, so
+            # 1024 keeps 2x headroom and banks ~0.5 ms of the 2.2 ms
+            # round
+            decode_width=1024,
             label="CoCo interference cost model (4 classes)",
             verbose=args.verbose,
         )

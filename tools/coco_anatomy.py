@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""The round-floor anatomy of the coco50k steady config — the
+partial-fusion (megakernel) probe VERDICT r4 #8 asked for.
+
+Non-preempt steady rounds sit at ~2.2 ms with the solve a minority
+term; whether a census+solve(+decode) Pallas megakernel is worth a
+future round depends on how the OTHER ~1.7 ms decomposes. Ablations
+(same protocol as bench.py's _device_bench, one variant per process
+run is NOT needed — each variant builds its own cluster/scan):
+
+  baseline    the suite's coco50k exactly
+  uncontended slots doubled (occupancy ~39%): supersteps collapse, the
+              residual is the census+cost+decode+bookkeeping floor
+  decode-512 / decode-8192
+              the [width, M] mover-ranking term, by slope
+
+Prints one JSON line per variant plus a floor decomposition.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    import bench
+    from ksched_tpu.costmodels import coco
+    from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
+
+    rng = np.random.default_rng(0)
+    penalties = rng.integers(0, 40, (1_000, 4)).astype(np.int64)
+
+    variants = [
+        ("baseline", dict(slots=16, decode_width=4096)),
+        ("uncontended-slots32", dict(slots=32, decode_width=4096)),
+        ("decode-512", dict(slots=16, decode_width=512)),
+        ("decode-8192", dict(slots=16, decode_width=8192)),
+    ]
+    out = {}
+    for name, kw in variants:
+        rec = bench._device_bench(
+            tasks=50_000, machines=1_000, pus=4, jobs=20,
+            churn=0.01, rounds=128, chunk=32,
+            num_task_classes=4,
+            class_cost_fn=coco_device_cost_fn(penalties),
+            unsched_cost=coco.UNSCHEDULED_COST,
+            ec_cost=0,
+            supersteps=1 << 17,
+            label=f"coco50k anatomy/{name}",
+            verbose=False,
+            **kw,
+        )
+        d = rec["detail"]
+        lm = d.get("latency_model") or {}
+        out[name] = {
+            "p50_ms": rec["value"],
+            "supersteps_p50": d.get("supersteps_p50"),
+            "fixed_ms": lm.get("fixed_ms"),
+            "per_superstep_us": lm.get("per_superstep_us"),
+            "chunks_wall_ms": d.get("chunks_wall_ms"),
+        }
+        print(f"# {name}: p50 {rec['value']} ss_p50 "
+              f"{d.get('supersteps_p50')}", file=sys.stderr)
+
+    base = out["baseline"]["p50_ms"]
+    unc = out["uncontended-slots32"]["p50_ms"]
+    d512 = out["decode-512"]["p50_ms"]
+    d8192 = out["decode-8192"]["p50_ms"]
+    # decode slope per 1k width from the 512->8192 spread
+    decode_slope = (d8192 - d512) / (8192 - 512) * 1024
+    out["decomposition"] = {
+        "solve_plus_contention_ms": round(base - unc, 4),
+        "decode_per_1024_width_ms": round(decode_slope, 4),
+        "decode_at_4096_ms_est": round(decode_slope * 4, 4),
+        "residual_floor_ms_est": round(
+            unc - decode_slope * 4, 4
+        ),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
