@@ -20,6 +20,7 @@ reference. Use --cold for full from-scratch solves instead.
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -538,7 +539,21 @@ def parse_overrides(pairs, allowed):
         k, sep, v = kv.partition("=")
         if not sep:
             raise SystemExit(f"--override wants K=V, got {kv!r}")
-        ov[k] = float(v) if "." in v else int(v)
+        try:
+            ov[k] = int(v)
+        except ValueError:
+            try:
+                # scientific notation ("rate=1e5") and decimals land
+                # here; malformed values exit cleanly, not a traceback
+                ov[k] = float(v)
+            except ValueError:
+                raise SystemExit(
+                    f"--override wants a numeric value, got {kv!r}"
+                ) from None
+            if not math.isfinite(ov[k]):
+                raise SystemExit(
+                    f"--override wants a finite value, got {kv!r}"
+                )
     unknown = set(ov) - set(allowed)
     if unknown:
         raise SystemExit(f"unknown --override keys: {sorted(unknown)}")
@@ -578,7 +593,7 @@ SUITE_CONFIGS = (
     "gtrace12k-coco",
 )
 #: configs runnable via --config but not part of the default suite
-EXTRA_CONFIGS = ("gtrace12k-host",)
+EXTRA_CONFIGS = ("gtrace12k-host", "mcmf-mega")
 
 
 def run_config(args) -> None:
@@ -805,6 +820,23 @@ def run_config(args) -> None:
             "unit": "ms",
             "vs_baseline": round(target_ms / max(stats.p50_ms, 1e-9), 3),
         }
+    elif name == "mcmf-mega":
+        # the general-graph megakernel microbench (ops/mcmf_pallas.py):
+        # mega vs the scan-based CSR/ELL backends on the 10k x 1k
+        # graph-path instance. On TPU the kernel runs compiled and the
+        # record carries the measured mega-vs-csr ratio; on CPU the
+        # kernel runs under the Pallas interpreter and the record marks
+        # the device claim unmeasured (tools/mcmf_mega_bench.py).
+        from tools.mcmf_mega_bench import run_bench as _mega_bench
+
+        pov = parse_overrides(args.override, ("tasks", "machines", "solves"))
+        out = _mega_bench(
+            tasks=int(pov.get("tasks", 10_000)),
+            machines=int(pov.get("machines", 1_000)),
+            solves=int(pov.get("solves", 8)),
+        )
+        if pov:
+            out["detail"]["overrides"] = dict(sorted(pov.items()))
     else:
         raise SystemExit(f"unknown config {name!r}; choose from {SUITE_CONFIGS}")
     out["config"] = name
@@ -1540,15 +1572,16 @@ def main():
     ap.add_argument("--cpu", action="store_true", help="run host-only on JAX-CPU (skip the accelerator); combine with --backend native/ref for the host solver paths")
     ap.add_argument(
         "--backend",
-        choices=["auto", "device", "layered", "jax", "ell", "native",
-                 "ref", "autograph"],
+        choices=["auto", "device", "layered", "jax", "ell", "mega",
+                 "native", "ref", "autograph"],
         default="auto",
         help=(
             "scheduling path: device = device-resident cluster (the TPU "
-            "production path), layered/jax/native/ref = host cluster with "
-            "that MCMF backend, autograph = host cluster with the "
-            "per-solve dense-vs-CSR dispatch (make_backend('auto')); "
-            "auto = device"
+            "production path), layered/jax/ell/mega/native/ref = host "
+            "cluster with that MCMF backend (mega = the VMEM-resident "
+            "Pallas megakernel, interpreter-backed off-TPU), autograph "
+            "= host cluster with the per-solve dense -> mega -> CSR "
+            "dispatch (make_backend('auto')); auto = device"
         ),
     )
     ap.add_argument(

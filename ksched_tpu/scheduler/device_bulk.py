@@ -590,11 +590,16 @@ class DeviceBulkCluster:
             fill path). Bounding matters at 50k+ tasks: the decode's
             [width, M] passes dominate the non-solve round cost.
 
-            supersteps_cap (static) bounds this round's transport
-            budget below the cluster-wide `supersteps` safety bound;
-            a capped solve may return converged=False, which the
-            three-tier hybrid uses as its escalation signal (the
-            caller discards the attempt)."""
+            supersteps_cap (static) bounds this round's TOTAL
+            transport budget below the cluster-wide `supersteps`
+            safety bound — on the grouped two-stage path the cap is
+            split across attempts (the stage-1 spend is subtracted
+            from the full-solve fallback's budget), so a
+            budget-exhausted stage 1 plus its fallback stay within
+            the documented escalated-tail bound. A capped solve may
+            return converged=False, which the three-tier hybrid uses
+            as its escalation signal (the caller discards the
+            attempt)."""
             ss_budget = (
                 supersteps
                 if supersteps_cap is None
@@ -751,7 +756,7 @@ class DeviceBulkCluster:
                     total_x = jnp.sum(supply_x)
                     eps_full_x = jnp.maximum(jnp.max(jnp.abs(wS_x)), i32(1))
 
-                    def solve_full(_):
+                    def solve_full(_, budget=ss_budget):
                         # eps0 = n_scale for grouped instances (not the
                         # global n_scale/4 default): the round-3 tail
                         # study's grouped replay shows blocked quincy
@@ -762,7 +767,7 @@ class DeviceBulkCluster:
                         # price-war steps (tools/tail_repro.py
                         # replay-grouped).
                         y_f, _pmf, s_f, c_f = transport_fori(
-                            wS_x, supply_x, col_cap, ss_budget,
+                            wS_x, supply_x, col_cap, budget,
                             alpha=2, refine_waves=8,
                             eps0=choose_eps0(
                                 n_scale, eps_full_x, total_x,
@@ -830,7 +835,22 @@ class DeviceBulkCluster:
                             return y_out, s1, conv1
 
                         def fall_back(_):
-                            y_f, s_f, c_f = solve_full(None)
+                            # round-total budget (ADVICE r5 #2): the
+                            # exhausted stage 1 spent up to s1_budget
+                            # of the cap, so the fallback gets the
+                            # remainder — the two attempts together
+                            # honor supersteps_cap instead of each
+                            # claiming it. A cap at or below s1_budget
+                            # leaves no remainder: return the failed
+                            # attempt as-is (conv1 is False on this
+                            # branch; the caller's escalation discards
+                            # it) instead of a futile token solve.
+                            fb_budget = ss_budget - min(s1_budget, ss_budget)
+                            if fb_budget <= 0:
+                                return y1, s1, conv1
+                            y_f, s_f, c_f = solve_full(
+                                None, budget=fb_budget
+                            )
                             return y_f, s1 + s_f, c_f
 
                         return lax.cond(

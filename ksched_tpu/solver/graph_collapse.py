@@ -15,7 +15,9 @@ predicate so the choice is automatic per solve:
          arc arrays. Collapsible -> group tasks into signature rows,
          solve ONE dense transport, reconstruct exact per-arc flows.
          Any refusal (with a reason, kept for observability) -> the
-         CSR backend, unchanged semantics.
+         general-graph backends, unchanged semantics: the VMEM-resident
+         Pallas megakernel (solver/mega_solver.py) when the graph fits
+         its tiling budget, else the scan-based CSR backend.
 
 Soundness: every refusal is conservative (routing to CSR can only cost
 time, never correctness), and the collapse itself is exact by the
@@ -654,27 +656,58 @@ def try_collapse(problem) -> Tuple[Optional[GraphCollapse], str]:
 
 
 class AutoSolver(FlowSolver):
-    """The automatic policy-dispatch seam: dense transport when the
-    graph is collapsible, the CSR backend otherwise. Drop-in FlowSolver
-    (PlacementSolver/FlowScheduler-compatible); `last_path` /
-    `last_refusal` expose which way each solve went."""
+    """The automatic policy-dispatch seam, now a three-rung ladder:
+    dense transport when the graph is collapsible, the VMEM-resident
+    Pallas megakernel (solver/mega_solver.py) when a general graph
+    fits the kernel's VMEM tiling budget, the scan-based CSR backend
+    as the guaranteed-correct fallback. Drop-in FlowSolver
+    (PlacementSolver/FlowScheduler-compatible); `last_path` ("dense" |
+    "mega" | "csr") / `last_refusal` / `last_mega_refusal` expose which
+    way each solve went and why.
+
+    `mega` is optional: without one the ladder is the historical
+    dense -> CSR dispatch. The cost model behind the mega rung is the
+    kernel's live-set arithmetic (ops/mcmf_pallas.py mega_fits_vmem):
+    escalation to scan-CSR happens exactly when the padded entry
+    tables exceed the VMEM budget, the scaled costs overflow the
+    kernel's int32 exactness contract, or the graph is degenerate in
+    a way the kernel's segment space cannot represent — every
+    refusal reason rides `MegaSolver.fits()`/`last_mega_refusal`."""
 
     def __init__(self, csr_backend: FlowSolver,
-                 alpha: int = 8, max_supersteps: int = 1 << 17):
+                 alpha: int = 8, max_supersteps: int = 1 << 17,
+                 mega: Optional[FlowSolver] = None):
         self.csr = csr_backend
+        self.mega = mega
         self.alpha = alpha
         self.max_supersteps = max_supersteps
         self.last_path = ""
         self.last_refusal = ""
+        self.last_mega_refusal = ""
         self.last_supersteps = 0
 
     def reset(self) -> None:
         self.csr.reset()
+        if self.mega is not None:
+            self.mega.reset()
 
     def solve(self, problem) -> FlowResult:
         collapse, reason = try_collapse(problem)
         if collapse is None:
+            mega = self.mega
+            if mega is not None and mega.fits(problem):
+                self.last_path, self.last_refusal = "mega", reason
+                self.last_mega_refusal = ""
+                res = mega.solve(problem)
+                self.last_supersteps = getattr(
+                    mega, "last_supersteps", res.iterations
+                )
+                return res
             self.last_path, self.last_refusal = "csr", reason
+            self.last_mega_refusal = (
+                getattr(mega, "last_refusal", "") if mega is not None
+                else "no megakernel attached"
+            )
             res = self.csr.solve(problem)
             ss = getattr(self.csr, "last_supersteps", None)
             self.last_supersteps = (
@@ -683,6 +716,7 @@ class AutoSolver(FlowSolver):
             )
             return res
         self.last_path, self.last_refusal = "dense", ""
+        self.last_mega_refusal = ""
         return self._solve_dense(problem, collapse)
 
     def _solve_dense(self, problem, gc: GraphCollapse) -> FlowResult:

@@ -14,8 +14,9 @@ from .base import FlowSolver
 
 
 def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> FlowSolver:
-    """name: "native" | "jax" | "ref". With fallback=True a failed
-    native build degrades to the JAX solver with a stderr note."""
+    """name: "native" | "jax" | "ell" | "mega" | "ref" | "layered" |
+    "auto". With fallback=True a failed native build degrades to the
+    JAX solver with a stderr note."""
     if name == "native":
         try:
             from .native import NativeSolver
@@ -39,6 +40,19 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         from .ell_solver import EllSolver
 
         return EllSolver(warm_start=warm_start)
+    if name == "mega":
+        # the Pallas megakernel (ops/mcmf_pallas.py): the whole
+        # push-relabel loop in one kernel launch, tables VMEM-resident
+        # for the solve — compiled on TPU, interpreter elsewhere.
+        # Graphs beyond the VMEM tiling budget delegate to the
+        # scan-based CSR solver so the backend stays total.
+        from .jax_solver import JaxSolver
+        from .mega_solver import MegaSolver
+
+        return MegaSolver(
+            warm_start=warm_start,
+            fallback=JaxSolver(warm_start=warm_start),
+        )
     if name == "ref":
         from .cpu_ref import ReferenceSolver
 
@@ -49,14 +63,26 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         return LayeredTransportSolver()
     if name == "auto":
         # the policy-dispatch seam (docs/solver_coverage.md): dense
-        # transport whenever the graph audits as collapsible, the CSR
-        # backend otherwise — per solve, automatically
+        # transport whenever the graph audits as collapsible, then the
+        # megakernel for general graphs inside its VMEM budget, the
+        # scan-based CSR backend as the total fallback — per solve,
+        # automatically. The mega rung is attached only when Pallas
+        # dispatch is live (TPU backend, or a forced "on"/"interpret"
+        # mode): interpreting the kernel on CPU would be strictly
+        # slower than the XLA scan path it replaces.
+        from ..ops import resolve_pallas
         from .graph_collapse import AutoSolver
 
+        mega = None
+        if resolve_pallas()[0]:
+            from .mega_solver import MegaSolver
+
+            mega = MegaSolver(warm_start=warm_start)
         return AutoSolver(
-            make_backend("native", warm_start=warm_start, fallback=fallback)
+            make_backend("native", warm_start=warm_start, fallback=fallback),
+            mega=mega,
         )
     raise ValueError(
-        f"unknown backend {name!r}; want native | jax | ell | ref | "
-        "layered | auto"
+        f"unknown backend {name!r}; want native | jax | ell | mega | "
+        "ref | layered | auto"
     )

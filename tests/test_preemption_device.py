@@ -503,7 +503,8 @@ def test_hybrid_preemption_replay_scan():
 # ---------------------------------------------------------------------------
 
 
-def _tri_cluster(every, global_every, seed=7, M=40, T=400, drift=0):
+def _tri_cluster(every, global_every, seed=7, M=40, T=400, drift=0,
+                 incr_budget=None, scoped_width=None):
     from ksched_tpu.costmodels import coco
     from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
 
@@ -517,6 +518,8 @@ def _tri_cluster(every, global_every, seed=7, M=40, T=400, drift=0):
         supersteps=1 << 16, preemption=True, continuation_discount=8,
         preempt_every=every, preempt_drift=drift,
         preempt_global_every=global_every,
+        preempt_incr_budget=incr_budget,
+        preempt_scoped_width=scoped_width,
         decode_width=256, track_realized_cost=True,
     )
     dev.add_tasks(T, rng.integers(0, 4, T).astype(np.int32),
@@ -605,7 +608,11 @@ def test_three_tier_global_cadence_and_quality():
 
 def test_three_tier_checkpoint_lockstep(tmp_path):
     """The global-cadence counter rides the checkpoint carry: original
-    and restored clusters fire identical scoped AND global schedules."""
+    and restored clusters fire identical scoped AND global schedules.
+    The cluster sets preempt_incr_budget AND the degenerate
+    preempt_scoped_width=0, so the round-trip covers both fields
+    (ADVICE r5 #3): a falsy-coerced width or a dropped budget would
+    break the lockstep resume asserted below."""
     from ksched_tpu.costmodels import coco
     from ksched_tpu.costmodels.device_costs import coco_device_cost_fn
     from ksched_tpu.runtime.checkpoint import (
@@ -613,7 +620,8 @@ def test_three_tier_checkpoint_lockstep(tmp_path):
         save_device_checkpoint,
     )
 
-    dev = _tri_cluster(every=2, global_every=8)
+    dev = _tri_cluster(every=2, global_every=8, incr_budget=1024,
+                       scoped_width=0)
     dev.fetch_stats(dev.run_steady_rounds(5, 0.05, 10, seed=2))
     path = str(tmp_path / "tri.npz")
     save_device_checkpoint(dev, path)
@@ -623,6 +631,8 @@ def test_three_tier_checkpoint_lockstep(tmp_path):
         path, class_cost_fn=coco_device_cost_fn(penalties)
     )
     assert back.preempt_global_every == 8
+    assert back.preempt_incr_budget == 1024
+    assert back.preempt_scoped_width == 0
     assert int(back._hyb_kg) == int(dev._hyb_kg)
     sa = dev.fetch_stats(dev.run_steady_rounds(10, 0.05, 10, seed=3))
     sb = back.fetch_stats(back.run_steady_rounds(10, 0.05, 10, seed=3))
