@@ -1,0 +1,31 @@
+# CI entry points — `make verify` is the PR gate (lint + tier-1 tests).
+#
+#   make lint      kschedlint AST rules over the library, tools, bench
+#   make test      tier-1 pytest (ROADMAP.md command; CPU, 8-dev mesh)
+#   make verify    lint, then tests
+#   make baseline  re-accept current lint violations (ratchet; avoid —
+#                  fix or suppress inline instead, docs/static_analysis.md)
+
+SHELL := /bin/bash
+
+PY ?= python
+LINT_PATHS = ksched_tpu tools bench.py
+
+.PHONY: lint test verify baseline
+
+lint:
+	$(PY) -m tools.kschedlint $(LINT_PATHS)
+
+test:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
+verify: lint test
+
+baseline:
+	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

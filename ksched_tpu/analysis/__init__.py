@@ -1,0 +1,39 @@
+"""Static-analysis suite: the codebase's TPU invariants, machine-checked.
+
+Two levels (docs/static_analysis.md has the full rule catalog):
+
+- Level 1, `ast_rules`: AST lint over the whole tree (driven by
+  `tools/kschedlint.py`, gated by `tests/test_static_analysis.py`).
+  Catches the invariants that live in *source text* — 64-bit dtypes in
+  device-bound modules, dtype-less jnp array creation, `jax.jit` calls
+  whose scalar knobs are missing from `static_argnames`, Python
+  control flow on traced values, mutable default args, bare excepts,
+  raw `print` in library code.
+- Level 2, `jaxpr_contracts`: abstract traces (`jax.make_jaxpr` over
+  `ShapeDtypeStruct`s — no device, no compile) of every registered
+  solver backend, asserting the invariants that live in the *traced
+  program* — no 64-bit `convert_element_type` anywhere, the
+  megakernel's zero-HBM-gather/zero-scatter budget, jaxpr-hash
+  stability across raw sizes sharing a pow2 padding bucket (the
+  recompile-hazard detector), and a VMEM estimate from the kernel's
+  actual operands cross-checked against the `mega_fits_vmem` gate.
+
+The split mirrors what each level can see: the AST rules catch hazards
+before a trace exists (and in code that never traces), the jaxpr
+contracts catch what only the traced program knows (a float64 sneaking
+in through promotion has no grep-able source form).
+"""
+
+from .ast_rules import RULES, Violation, lint_file, lint_paths
+from .baseline import fingerprint, load_baseline, split_by_baseline, write_baseline
+
+__all__ = [
+    "RULES",
+    "Violation",
+    "lint_file",
+    "lint_paths",
+    "fingerprint",
+    "load_baseline",
+    "split_by_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,401 @@
+"""Level-2 contracts: invariants checked on the TRACED program.
+
+Every registered solver backend (solver/select.py: jax, ell, mega,
+layered, plus parallel/sharded_*) is traced abstractly with
+`jax.make_jaxpr` over `ShapeDtypeStruct`s — no device arrays, no
+compile, CPU-safe — and the resulting jaxpr is walked recursively
+(pjit / while / cond / scan / pallas_call sub-jaxprs included) to
+assert:
+
+- **no-64bit**: no `convert_element_type` (or iota/constant aval) with
+  a 64-bit dtype anywhere. "Everything is int32" (solver/jax_solver.py
+  header: TPU v5e has no native int64) holds in the traced program,
+  not just in the source text the AST lint sees.
+- **no-scatter**: zero scatter-family primitives in any backend's
+  solve. TPU serializes scatter-adds (~68 ms for a 64k segment_sum,
+  jax_solver.py header); every segment reduction must stay in
+  cumsum/gather/associative-scan form.
+- **mega gather budget** (locking in the megakernel's zero-HBM-gather
+  claim, ops/mcmf_pallas.py): inside the mega `pallas_call` body every
+  operand is VMEM/SMEM-resident by BlockSpec construction, the only
+  gathers are the pinned partner-permutation reads, and OUTSIDE the
+  kernel no gather sits inside a loop body — so per-superstep HBM
+  gather traffic is exactly zero; the one-shot entry materialization
+  runs once per solve.
+- **pow2-bucket stability** (recompile-hazard detector): two raw
+  problem sizes sharing a pow2 padding bucket must produce
+  byte-identical jaxprs — if a raw size leaks into a static argument
+  or a host-derived shape, the hash splits and the gate names the
+  recompile before a production cluster discovers it as a per-round
+  compile stall.
+- **VMEM estimate**: the megakernel's live set, counted from the
+  actual `pallas_call` block mappings, must agree with the
+  `_MEGA_LIVE_TILES` constant behind `mega_fits_vmem` — the dispatch
+  gate can never drift from the kernel it guards.
+
+The ELL and sharded backends build entry tables whose SHAPES depend on
+graph structure (degree buckets / per-shard maxima), not only on
+(n, m); they get the dtype/scatter contracts via plans built from a
+deterministic generator graph, and are exempt from the bucket-hash
+contract (their recompile unit is the plan rebuild, which existing
+tests cover). See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+#: the backend names this suite traces, mirroring solver/select.py
+#: ("native" is C++, "ref" is pure numpy, "auto" composes the others)
+REGISTERED_BACKENDS = ("jax", "ell", "mega", "layered", "sharded")
+
+#: backends whose traced shapes are a function of the padded (n, m)
+#: alone — the pow2-bucket hash contract applies to exactly these
+HASH_STABLE_BACKENDS = ("jax", "mega", "layered")
+
+_64BIT = frozenset({"int64", "uint64", "float64", "complex128"})
+
+#: gathers inside the mega kernel body: one per `perm()` site in the
+#: traced program (tighten body, post-tighten saturate, and the phase
+#: loop's saturate + superstep rc/delta/relabel reads). All read the
+#: VMEM-resident partner tables. A changed count means the kernel's
+#: data-movement structure changed — re-derive, re-measure, re-pin.
+MEGA_KERNEL_PERM_GATHERS = 6
+
+#: VMEM tiles the kernel holds live beyond its I/O operands (loop
+#: state flow/potential + excess/residual/admissibility temporaries +
+#: the segmented-scan value/flag pair), matching the accounting that
+#: sized _MEGA_LIVE_TILES in ops/mcmf_pallas.py
+MEGA_SCAN_TEMP_TILES = 8
+
+#: slack allowed between the counted estimate and the gate constant
+#: before the contract demands the gate be re-derived
+MEGA_VMEM_GATE_SLACK_TILES = 4
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(eqn) -> Iterable:
+    for val in eqn.params.values():
+        for sub in val if isinstance(val, (list, tuple)) else [val]:
+            core = getattr(sub, "jaxpr", sub)
+            if hasattr(core, "eqns"):
+                yield core
+
+
+def walk_eqns(jaxpr, in_pallas: bool = False, in_loop: bool = False):
+    """Yield (eqn, in_pallas, in_loop) over the whole nested jaxpr.
+    `in_loop` marks bodies whose eqns run per loop iteration (while /
+    scan); `in_pallas` marks the kernel body, where every operand is
+    on-chip by BlockSpec construction."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        yield eqn, in_pallas, in_loop
+        child_pallas = in_pallas or name == "pallas_call"
+        child_loop = in_loop or name in ("while", "scan")
+        for sub in _sub_jaxprs(eqn):
+            yield from walk_eqns(sub, child_pallas, child_loop)
+
+
+def _aval_dtypes(eqn) -> Iterable[str]:
+    for var in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(var, "aval", None)
+        dtype = getattr(aval, "dtype", None)
+        if dtype is not None:
+            yield str(dtype)
+
+
+# ---------------------------------------------------------------------------
+# contracts
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContractReport:
+    backend: str
+    shape_key: Tuple
+    num_eqns: int
+    violations_64bit: List[str]
+    scatter_eqns: List[str]
+    hbm_loop_gathers: int  # gathers outside pallas_call, inside loop bodies
+    kernel_gathers: int  # gathers inside a pallas_call body (VMEM reads)
+    oneshot_gathers: int  # gathers outside any loop (per-solve, not per-step)
+    jaxpr_hash: str
+
+    @property
+    def ok_64bit(self) -> bool:
+        return not self.violations_64bit
+
+    @property
+    def ok_scatter(self) -> bool:
+        return not self.scatter_eqns
+
+
+def jaxpr_hash(closed) -> str:
+    return hashlib.sha256(str(closed).encode()).hexdigest()[:16]
+
+
+def check_jaxpr(backend: str, closed, shape_key: Tuple = ()) -> ContractReport:
+    violations_64bit: List[str] = []
+    scatter_eqns: List[str] = []
+    hbm_loop = kernel = oneshot = 0
+    num_eqns = 0
+    for eqn, in_pallas, in_loop in walk_eqns(closed.jaxpr):
+        num_eqns += 1
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            new = str(eqn.params.get("new_dtype"))
+            if new in _64BIT:
+                violations_64bit.append(f"convert_element_type -> {new}")
+        for dtype in _aval_dtypes(eqn):
+            if dtype in _64BIT:
+                violations_64bit.append(f"{name}: {dtype} aval")
+        if name.startswith("scatter"):
+            scatter_eqns.append(name)
+        elif name == "gather":
+            if in_pallas:
+                kernel += 1
+            elif in_loop:
+                hbm_loop += 1
+            else:
+                oneshot += 1
+    return ContractReport(
+        backend=backend,
+        shape_key=shape_key,
+        num_eqns=num_eqns,
+        violations_64bit=violations_64bit,
+        scatter_eqns=scatter_eqns,
+        hbm_loop_gathers=hbm_loop,
+        kernel_gathers=kernel,
+        oneshot_gathers=oneshot,
+        jaxpr_hash=jaxpr_hash(closed),
+    )
+
+
+@dataclass
+class MegaVmemEstimate:
+    R: int
+    L: int
+    io_tiles: int  # VMEM [R, L] operands (inputs + outputs) of the kernel
+    smem_operands: int
+    io_bytes: int
+    est_tiles: int  # io_tiles + MEGA_SCAN_TEMP_TILES
+    est_bytes: int
+    gate_tiles: int  # _MEGA_LIVE_TILES, what mega_fits_vmem budgets with
+    all_operands_on_chip: bool  # no ANY/HBM-spec'd kernel operands
+
+    @property
+    def gate_is_safe(self) -> bool:
+        """The gate budgets at least the kernel's real live set."""
+        return self.gate_tiles >= self.est_tiles
+
+    @property
+    def gate_is_tight(self) -> bool:
+        """...and not so conservatively that it has clearly drifted."""
+        return self.gate_tiles <= self.est_tiles + MEGA_VMEM_GATE_SLACK_TILES
+
+
+def find_pallas_calls(closed) -> List:
+    return [e for e, _, _ in walk_eqns(closed.jaxpr) if e.primitive.name == "pallas_call"]
+
+
+def estimate_mega_vmem(closed) -> MegaVmemEstimate:
+    from ..ops.mcmf_pallas import _MEGA_LIVE_TILES
+
+    calls = find_pallas_calls(closed)
+    assert len(calls) == 1, f"expected exactly one pallas_call, found {len(calls)}"
+    grid_mapping = calls[0].params["grid_mapping"]
+    vmem_shapes = []
+    smem = 0
+    on_chip = True
+    for bm in grid_mapping.block_mappings:
+        space = str(getattr(bm, "block_aval", "")).lower()
+        if "vmem" in space:
+            vmem_shapes.append(tuple(bm.block_shape))
+        elif "smem" in space:
+            smem += 1
+        else:
+            on_chip = False
+    assert vmem_shapes, "mega kernel has no VMEM operands?"
+    tile_shapes = {s for s in vmem_shapes if len(s) == 2}
+    assert len(tile_shapes) == 1, f"mixed VMEM tile shapes: {tile_shapes}"
+    (R, L), = tile_shapes
+    io_tiles = len(vmem_shapes)
+    est_tiles = io_tiles + MEGA_SCAN_TEMP_TILES
+    return MegaVmemEstimate(
+        R=int(R), L=int(L),
+        io_tiles=io_tiles,
+        smem_operands=smem,
+        io_bytes=io_tiles * int(R) * int(L) * 4,
+        est_tiles=est_tiles,
+        est_bytes=est_tiles * int(R) * int(L) * 4,
+        gate_tiles=_MEGA_LIVE_TILES,
+        all_operands_on_chip=on_chip,
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-backend abstract tracing
+# ---------------------------------------------------------------------------
+
+
+def bucketed_sizes(n_raw: int, m_raw: int) -> Tuple[int, int]:
+    """(Np, Mp): the padded extents DeviceGraphState hands every
+    solver (graph/device_export.py full_build) — the pow2 bucket."""
+    from ..utils import next_pow2
+
+    return max(next_pow2(n_raw), 16), max(next_pow2(m_raw), 16)
+
+
+def _sds(shape, dtype=jnp.int32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _generator_graph(n: int, m: int, seed: int = 0):
+    """Deterministic connected-ish multigraph with skewed degrees (so
+    the ELL plan exercises both the small and hub buckets)."""
+    rng = np.random.default_rng(seed)
+    src = np.where(
+        np.arange(m) % 3 == 0, 0, rng.integers(0, n, m)
+    ).astype(np.int32)
+    dst = ((src + 1 + rng.integers(0, n - 1, m)) % n).astype(np.int32)
+    return src, dst
+
+
+def trace_jax(n_raw: int, m_raw: int, seed: int = 0):
+    from ..solver.jax_solver import _solve_mcmf
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    fn = functools.partial(
+        _solve_mcmf, alpha=8, max_supersteps=4096, tighten_sweeps=32
+    )
+    e = 2 * m
+    return jax.make_jaxpr(fn)(
+        _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()),
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)),
+        _sds((e,), jnp.bool_), _sds((e,)),
+        _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+    )
+
+
+def trace_ell(n_raw: int, m_raw: int, seed: int = 0):
+    from ..solver.ell_solver import _solve_mcmf_ell, build_ell_plan, _plan_args
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    src, dst = _generator_graph(n, m, seed)
+    plan_args = build_ell_plan(src, dst, n)
+    fn = functools.partial(
+        _solve_mcmf_ell, alpha=8, max_supersteps=4096, tighten_sweeps=32
+    )
+    plan_sds = tuple(_sds(np.shape(x), np.asarray(x).dtype) for x in _plan_args(plan_args))
+    return jax.make_jaxpr(fn)(
+        _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()),
+        *plan_sds,
+    )
+
+
+def trace_mega(n_raw: int, m_raw: int, seed: int = 0):
+    from ..ops.mcmf_pallas import MEGA_LANES, mcmf_loop_pallas, mega_entry_rows
+    from ..utils import next_pow2
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    # mirrors MegaSolver's host prep: cap/cost/flow0/fwd_pos padded by
+    # _pad_pow2 (floor 256), entry tables tiled [R, MEGA_LANES]
+    mp = max(256, next_pow2(m))
+    npad = max(256, next_pow2(n))
+    R = mega_entry_rows(2 * m)
+    L = MEGA_LANES
+    e = R * L
+    fn = functools.partial(
+        mcmf_loop_pallas, R=R, L=L, alpha=8, max_supersteps=4096,
+        tighten_sweeps=32, interpret=False,
+    )
+    return jax.make_jaxpr(fn)(
+        _sds((mp,)), _sds((mp,)), _sds((npad,)), _sds((mp,)), _sds(()),
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)),
+        _sds((e,)), _sds((e,)), _sds((mp,)),
+    )
+
+
+def trace_layered(n_raw: int, m_raw: int, seed: int = 0):
+    """(n_raw, m_raw) doubles as (num_classes, num_machines): the
+    layered backend's problem geometry."""
+    from ..solver.layered import _solve_transport, pad_geometry
+
+    C = max(1, n_raw)
+    Mp, _n_scale = pad_geometry(m_raw, C)
+    fn = functools.partial(
+        _solve_transport, alpha=8, max_supersteps=4096, refine_waves=0
+    )
+    return jax.make_jaxpr(fn)(
+        _sds((C, Mp)), _sds((C,)), _sds((Mp,)), _sds(()), _sds((Mp,))
+    )
+
+
+def trace_sharded(n_raw: int, m_raw: int, seed: int = 0):
+    from jax.sharding import Mesh
+
+    from ..parallel.sharded_solver import build_sharded_plan, make_sharded_solver
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    src, dst = _generator_graph(n, m, seed)
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("x",))
+    plan = build_sharded_plan(src, dst, n, len(devices))
+    fn = make_sharded_solver(mesh, "x", alpha=8, max_supersteps=4096)
+    plan_sds = tuple(
+        _sds(np.shape(x), np.asarray(x).dtype)
+        for x in (
+            plan.s_arc, plan.s_sign, plan.s_src, plan.s_dst,
+            plan.s_segstart, plan.s_isstart, plan.s_valid,
+            plan.node_first, plan.node_last, plan.node_nonempty,
+            plan.owned, plan.pos_fwd, plan.pos_bwd,
+        )
+    )
+    return jax.make_jaxpr(fn)(
+        _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()), _sds(()),
+        *plan_sds,
+    )
+
+
+TRACERS = {
+    "jax": trace_jax,
+    "ell": trace_ell,
+    "mega": trace_mega,
+    "layered": trace_layered,
+    "sharded": trace_sharded,
+}
+
+
+@functools.lru_cache(maxsize=64)
+def traced(backend: str, n_raw: int, m_raw: int, seed: int = 0):
+    """Cached abstract trace: the contract tests revisit the same
+    (backend, bucket) pairs, and tracing (the megakernel especially)
+    dominates the suite's tier-1 cost."""
+    return TRACERS[backend](n_raw, m_raw, seed)
+
+
+def backend_report(backend: str, n_raw: int, m_raw: int, seed: int = 0) -> ContractReport:
+    closed = traced(backend, n_raw, m_raw, seed)
+    return check_jaxpr(backend, closed, shape_key=(n_raw, m_raw))
+
+
+def recompile_hazard(
+    backend: str, raw_a: Tuple[int, int], raw_b: Tuple[int, int], seed: int = 0
+) -> Tuple[str, str]:
+    """Jaxpr hashes for two raw sizes; equal hashes = one executable
+    serves both (no recompile inside the bucket)."""
+    return (
+        jaxpr_hash(traced(backend, *raw_a, seed)),
+        jaxpr_hash(traced(backend, *raw_b, seed)),
+    )

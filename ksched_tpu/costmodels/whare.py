@@ -50,7 +50,10 @@ PSI_PRIOR = np.array(
         [120, 130, 150, 105],  # devil
         [100, 100, 102, 100],  # turtle
     ],
-    dtype=np.int64,
+    # int32: psi values stay O(10^4) (slowdown x100), census counts
+    # O(slots), so products sit far below 2^31 — and the matrix feeds
+    # device-bound int32 cost arrays anyway
+    dtype=np.int32,
 )
 
 IDLE_BONUS = 20
@@ -92,7 +95,9 @@ class WhareMapCostModel(CostModeler):
         self.task_map = task_map
         self.leaf_resource_ids = leaf_resource_ids
         self.census = ClassCensusKeeper(resource_map, task_map, max_tasks_per_pu)
-        self.psi = PSI_PRIOR.astype(np.float64).copy()
+        # float32 is ample for an EWMA over x100 slowdowns (24-bit
+        # mantissa vs values O(10^4)); 64-bit buys nothing here
+        self.psi = PSI_PRIOR.astype(np.float32).copy()
 
     # -- the map (online learning) ----------------------------------------
 
@@ -106,7 +111,7 @@ class WhareMapCostModel(CostModeler):
         )
 
     def psi_int(self) -> np.ndarray:
-        return np.rint(self.psi).astype(np.int64)
+        return np.rint(self.psi).astype(np.int32)
 
     # -- arc costs --------------------------------------------------------
 

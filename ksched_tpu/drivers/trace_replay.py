@@ -182,12 +182,12 @@ def synthesize_trace(
         burst_t = starts[which] * 1e6 + inside
         arrivals = np.sort(
             np.concatenate([base, burst_t])
-        ).astype(np.int64)
+        ).astype(np.int64)  # kschedlint: host-only (synthetic trace gen, host-side)
     else:
         arrivals = np.sort(
             rng.uniform(0, duration_s * 1e6, num_tasks)
-        ).astype(np.int64)
-    runtimes = (rng.exponential(mean_runtime_s, num_tasks) * 1e6).astype(np.int64)
+        ).astype(np.int64)  # kschedlint: host-only (synthetic trace gen, host-side)
+    runtimes = (rng.exponential(mean_runtime_s, num_tasks) * 1e6).astype(np.int64)  # kschedlint: host-only (synthetic trace gen, host-side)
     jobs = rng.integers(1, max(2, num_tasks // 50), num_tasks)
     events: List[TraceTaskEvent] = []
     for i in range(num_tasks):
@@ -506,7 +506,7 @@ class DeviceTraceReplayDriver:
         if class_cost_fn is None:
             # distinct per-job escape costs (u_j > e = 0 so placement
             # always profits): the row-constant per-job shape
-            job_u = 1 + (np.arange(num_jobs_hint, dtype=np.int64) % 8)
+            job_u = 1 + (np.arange(num_jobs_hint, dtype=np.int64) % 8)  # kschedlint: host-only (synthetic trace gen, host-side)
             self.cluster = DeviceBulkCluster(
                 num_machines=self.num_machines,
                 pus_per_machine=1,

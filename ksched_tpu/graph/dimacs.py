@@ -130,7 +130,18 @@ def parse_flow(lines: Iterable[str]) -> Dict[Tuple[int, int], int]:
         parts = line.split()
         if parts[0] != "f":
             raise ValueError(f"unexpected line in flow response: {line!r}")
-        s, d, f = (int(x) for x in parts[1:4])
+        if len(parts) < 4:
+            raise ValueError(f"truncated flow line (want `f src dst flow`): {line!r}")
+        if len(parts) > 4:
+            # a flow value split by pipe corruption must not silently
+            # decode as its first fragment
+            raise ValueError(f"trailing fields on flow line: {line!r}")
+        try:
+            s, d, f = (int(x) for x in parts[1:4])
+        except ValueError:
+            raise ValueError(f"non-integer field in flow line: {line!r}") from None
+        if f < 0:
+            raise ValueError(f"negative flow in response line: {line!r}")
         flows[(s, d)] = f
     if not terminated:
         # A dead solver / cut pipe must fail loudly, not decode as a
@@ -154,21 +165,105 @@ def flow_on_arcs(flows: Dict[Tuple[int, int], int], src, dst):
     return out
 
 
+def _ints(parts: List[str], line: str, lineno: int) -> Tuple[int, ...]:
+    try:
+        return tuple(int(x) for x in parts)
+    except ValueError:
+        raise ValueError(
+            f"DIMACS line {lineno}: non-integer field in {line!r}"
+        ) from None
+
+
 def parse_graph(lines: Iterable[str]):
     """Parse a full-graph DIMACS export into (num_nodes, node_lines, arc_lines)
-    tuples of ints, for golden-file tests."""
+    tuples of ints, for golden-file tests and external-solver interop.
+
+    Malformed input fails loudly with the offending line — a truncated
+    arc line, a negative capacity, or a node id outside the header's
+    range must never decode into a flow problem that silently
+    mis-places flow (downstream indexes device arrays by these ids)."""
     nodes: List[tuple] = []
     arcs: List[tuple] = []
     header = None
-    for line in lines:
+    terminated = False
+    for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line or line.startswith("c"):
+            if line == "c EOI":
+                terminated = True
             continue
         parts = line.split()
         if parts[0] == "p":
-            header = (int(parts[2]), int(parts[3]))
+            if len(parts) < 4 or parts[1] != "min":
+                raise ValueError(
+                    f"DIMACS line {lineno}: malformed header (want `p min N M`): {line!r}"
+                )
+            header = _ints(parts[2:4], line, lineno)
+            if header[0] < 0 or header[1] < 0:
+                raise ValueError(
+                    f"DIMACS line {lineno}: negative extent in header: {line!r}"
+                )
         elif parts[0] == "n":
-            nodes.append(tuple(int(x) for x in parts[1:]))
+            if header is None:
+                raise ValueError(
+                    f"DIMACS line {lineno}: node line before `p min` header"
+                )
+            if len(parts) < 3:
+                raise ValueError(
+                    f"DIMACS line {lineno}: truncated node line "
+                    f"(want `n id excess [type]`): {line!r}"
+                )
+            fields = _ints(parts[1:], line, lineno)
+            # ids are 1-based (graph/flowgraph.py IDGenerator(start=1));
+            # 0 is tolerated as the device-array padding row
+            if not 0 <= fields[0] <= header[0]:
+                raise ValueError(
+                    f"DIMACS line {lineno}: node id {fields[0]} out of range "
+                    f"[0, {header[0]}]: {line!r}"
+                )
+            nodes.append(fields)
         elif parts[0] == "a":
-            arcs.append(tuple(int(x) for x in parts[1:]))
+            if header is None:
+                raise ValueError(
+                    f"DIMACS line {lineno}: arc line before `p min` header"
+                )
+            if len(parts) < 6:
+                raise ValueError(
+                    f"DIMACS line {lineno}: truncated arc line "
+                    f"(want `a src dst low cap cost [type]`): {line!r}"
+                )
+            fields = _ints(parts[1:], line, lineno)
+            src, dst, low, cap = fields[0], fields[1], fields[2], fields[3]
+            for nid in (src, dst):
+                if not 0 <= nid <= header[0]:
+                    raise ValueError(
+                        f"DIMACS line {lineno}: arc endpoint {nid} out of range "
+                        f"[0, {header[0]}]: {line!r}"
+                    )
+            if low < 0 or cap < 0:
+                raise ValueError(
+                    f"DIMACS line {lineno}: negative capacity: {line!r}"
+                )
+            if cap < low:
+                raise ValueError(
+                    f"DIMACS line {lineno}: upper capacity {cap} below lower "
+                    f"bound {low}: {line!r}"
+                )
+            arcs.append(fields)
+        else:
+            raise ValueError(
+                f"DIMACS line {lineno}: unknown record type {parts[0]!r}: {line!r}"
+            )
+    if header is not None:
+        # a dead writer / cut pipe must fail loudly, not decode as a
+        # partial graph (mirrors parse_flow's terminator contract);
+        # node lines are not counted — standard DIMACS lists only
+        # nonzero-excess nodes
+        if not terminated:
+            raise ValueError("DIMACS stream truncated: no 'c EOI' terminator")
+        if len(arcs) != header[1]:
+            raise ValueError(
+                f"DIMACS stream truncated: header declares {header[1]} arcs, "
+                f"got {len(arcs)}"
+            )
     return header, nodes, arcs

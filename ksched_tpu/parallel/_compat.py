@@ -1,0 +1,21 @@
+"""One compat seam for the shard_map API across jax versions.
+
+jax >= 0.6 exports `jax.shard_map` (with varying-ness tracking that
+`lax.pcast` feeds); earlier versions ship it under
+`jax.experimental.shard_map`, whose replication checker has no rule
+for `lax.while_loop` — there the solvers pass `check_rep=False` (their
+psum/pmin combines are rep-correct by construction: owner-masked dense
+vectors) and pcast-style varying marks are unnecessary. Both sharded
+modules import from here so the two detections can never diverge.
+"""
+
+try:
+    from jax import shard_map
+
+    SHARD_MAP_KWARGS: dict = {}
+    IS_EXPERIMENTAL = False
+except ImportError:
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+    SHARD_MAP_KWARGS = {"check_rep": False}
+    IS_EXPERIMENTAL = True

@@ -119,10 +119,10 @@ def build_sharded_plan(src: np.ndarray, dst: np.ndarray, num_nodes: int, num_sha
         s_sign[d, :k] = esign[ix][order]
         s_valid[d, :k] = True
         counts = np.bincount(lsrc, minlength=num_nodes)
-        row_ptr = np.zeros(num_nodes + 1, np.int64)
+        row_ptr = np.zeros(num_nodes + 1, np.int64)  # kschedlint: host-only (numpy plan build)
         row_ptr[1:] = np.cumsum(counts)
         s_segstart[d, :k] = row_ptr[lsrc]
-        starts = np.unique(row_ptr[lsrc]).astype(np.int64)
+        starts = np.unique(row_ptr[lsrc]).astype(np.int64)  # kschedlint: host-only (numpy plan build)
         s_isstart[d, starts] = True
         node_first[d] = np.minimum(row_ptr[:-1], max(e_pad - 1, 0))
         node_last[d] = np.maximum(row_ptr[1:] - 1, 0)
@@ -130,7 +130,7 @@ def build_sharded_plan(src: np.ndarray, dst: np.ndarray, num_nodes: int, num_sha
         owned[d] = node_owner_arr == d
         # Map arc -> local entry position (padding position reads delta 0
         # because padded entries are never admissible).
-        local_pos = np.empty(k, np.int64)
+        local_pos = np.empty(k, np.int64)  # kschedlint: host-only (numpy plan build)
         local_pos[:] = np.arange(k)
         glob = ix[order]
         is_fwd = glob < m
@@ -173,7 +173,7 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
     per-shard plan arrays arrive as call arguments (sharded on their
     leading axis); nothing is baked into the compiled function besides
     shapes."""
-    from jax import shard_map
+    from ._compat import SHARD_MAP_KWARGS as shard_map_kwargs, shard_map
 
     spec_sharded = P(axis)
     spec_repl = P()
@@ -308,7 +308,10 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
         spec_sharded, spec_sharded, spec_sharded,
     )
     out_specs = (spec_repl, spec_repl, spec_repl, spec_repl)
-    fn = shard_map(solve_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    fn = shard_map(
+        solve_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **shard_map_kwargs,
+    )
     return jax.jit(fn)
 
 
@@ -340,7 +343,7 @@ class ShardedJaxSolver(FlowSolver):
         if m == 0 or problem.num_arcs == 0:
             if (problem.excess > 0).any():
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
-            return FlowResult(flow=np.zeros(m, dtype=np.int64), objective=0, iterations=0)
+            return FlowResult(flow=np.zeros(m, dtype=np.int64), objective=0, iterations=0)  # kschedlint: host-only (FlowResult contract is int64)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
         cap = problem.cap.astype(np.int32)
@@ -409,7 +412,7 @@ class ShardedJaxSolver(FlowSolver):
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
         objective = int(
-            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
-            + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
+            + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         )
-        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))
+        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))  # kschedlint: host-only (FlowResult contract is int64)

@@ -49,6 +49,24 @@ from ..solver.layered import (
 
 AXIS = "x"
 
+from ._compat import (  # noqa: E402  (see _compat.py for the version story)
+    IS_EXPERIMENTAL as _SHARD_MAP_EXPERIMENTAL,
+    SHARD_MAP_KWARGS as _SHARD_MAP_KWARGS,
+    shard_map as _shard_map,
+)
+
+
+def _pcast_varying(x):
+    """`lax.pcast(..., to="varying")` on the modern shard_map; under
+    the experimental one (check_rep=False, _compat.py) there is no
+    varying-ness tracking to satisfy, so identity is correct. Keyed on
+    WHICH shard_map was selected — not on pcast's presence — so a jax
+    with modern shard_map but no pcast fails loudly at trace time
+    instead of silently skipping the varying mark."""
+    if _SHARD_MAP_EXPERIMENTAL:
+        return x
+    return lax.pcast(x, (AXIS,), to="varying")
+
 
 def _global_excl_prefix(local_vals, axis_name):
     """Exclusive prefix (over the global column order) of per-column
@@ -61,7 +79,7 @@ def _global_excl_prefix(local_vals, axis_name):
     all_tot = lax.all_gather(local_tot, axis_name)  # [D, ..., 1]
     me = lax.axis_index(axis_name)
     d = all_tot.shape[0]
-    mask = (jnp.arange(d) < me).reshape((d,) + (1,) * (all_tot.ndim - 1))
+    mask = (jnp.arange(d, dtype=jnp.int32) < me).reshape((d,) + (1,) * (all_tot.ndim - 1))
     offset = jnp.sum(jnp.where(mask, all_tot, 0), axis=0)
     return local_excl + offset
 
@@ -182,8 +200,8 @@ def _sharded_transport_fn(wS, supply, col_cap, eps0, alpha, max_supersteps):
     # zeros materialized inside the shard body are "unvarying" in
     # shard_map's manual-axes tracking; mark them device-varying so the
     # while carry types match after the first superstep
-    y0 = lax.pcast(jnp.zeros((C, Mloc), i32), (AXIS,), to="varying")
-    z0 = lax.pcast(jnp.zeros((Mloc,), i32), (AXIS,), to="varying")
+    y0 = _pcast_varying(jnp.zeros((C, Mloc), i32))
+    z0 = _pcast_varying(jnp.zeros((Mloc,), i32))
     state = (y0, z0, pr0, pm0, psink0, eps0, i32(0), jnp.bool_(False))
     y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
         phase_cond, phase_body, state
@@ -206,13 +224,14 @@ def sharded_transport_solve(
     col_cap int32[Mp]; Mp must be divisible by the mesh size.
     Returns (y [C, Mp], steps, converged), bit-identical to the
     single-device solve."""
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(
             _sharded_transport_fn, alpha=alpha, max_supersteps=max_supersteps
         ),
         mesh=mesh,
         in_specs=(P(None, AXIS), P(None), P(AXIS), P()),
         out_specs=(P(None, AXIS), P(), P()),
+        **_SHARD_MAP_KWARGS,
     )
     return fn(wS, supply, col_cap, eps0)
 
@@ -470,8 +489,8 @@ def _sharded_transport_tiered_fn(wLo, wHi, R, supply, col_cap, eps0,
 
         return lax.cond(any_active, do_step, next_phase, operand=None)
 
-    y0 = lax.pcast(jnp.zeros((C, Mloc), i32), (AXIS,), to="varying")
-    z0 = lax.pcast(jnp.zeros((Mloc,), i32), (AXIS,), to="varying")
+    y0 = _pcast_varying(jnp.zeros((C, Mloc), i32))
+    z0 = _pcast_varying(jnp.zeros((Mloc,), i32))
     state = (y0, z0, pr0, pm0, psink0, eps0, i32(0), jnp.bool_(False))
     y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
         phase_cond, phase_body, state
@@ -501,7 +520,7 @@ def sharded_transport_solve_tiered(
     preemption runs refine_waves=8 — pass it here too for the same
     superstep counts; the host-solver bit-parity convention keeps 0
     the default)."""
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(
             _sharded_transport_tiered_fn,
             alpha=alpha, max_supersteps=max_supersteps,
@@ -511,5 +530,6 @@ def sharded_transport_solve_tiered(
         in_specs=(P(None, AXIS), P(None, AXIS), P(None, AXIS), P(None),
                   P(AXIS), P()),
         out_specs=(P(None, AXIS), P(), P()),
+        **_SHARD_MAP_KWARGS,
     )
     return fn(wLo, wHi, R, supply, col_cap, eps0)

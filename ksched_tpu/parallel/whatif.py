@@ -116,12 +116,12 @@ class WhatIfSolver:
         supply: np.ndarray,  # int[K, C]
         col_cap: np.ndarray,  # int[K, M]
     ) -> ScenarioBatchResult:
-        supply = np.asarray(supply, np.int64)
-        col_cap = np.asarray(col_cap, np.int64)
+        supply = np.asarray(supply, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
+        col_cap = np.asarray(col_cap, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
         K = supply.shape[0]
         if cost_cm.ndim == 2:
             cost_cm = np.broadcast_to(cost_cm, (K,) + cost_cm.shape)
-        cost_cm = np.asarray(cost_cm, np.int64)
+        cost_cm = np.asarray(cost_cm, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
         assert cost_cm.shape == (K, self.C, self.M), cost_cm.shape
         assert supply.shape == (K, self.C) and col_cap.shape == (K, self.M)
 
@@ -152,7 +152,7 @@ class WhatIfSolver:
             self.max_supersteps,
             degenerate,
         )
-        y_np = np.asarray(y).astype(np.int64)[:, :, : self.M]
+        y_np = np.asarray(y).astype(np.int64)[:, :, : self.M]  # kschedlint: host-only (host decode of device results)
         placed = y_np.sum(axis=(1, 2))
         objective = self.unsched_cost * (totals - placed) + (
             (cost_cm + self.ec_cost) * y_np
@@ -179,7 +179,7 @@ def _cluster_snapshot(cluster):
     base_supply = np.bincount(cluster.task_class[unplaced], minlength=C)
     cost_cm = cluster.cost[
         cluster.a_ecm0 : cluster.a_ecm0 + C * M
-    ].reshape(C, M).astype(np.int64)
+    ].reshape(C, M).astype(np.int64)  # kschedlint: host-only (host decode of device results)
     return machine_free, base_supply, cost_cm
 
 
@@ -188,7 +188,7 @@ def drain_scenarios(cluster, machine_indices) -> ScenarioBatchResult:
     cluster's current unplaced backlog PLUS machine k's displaced tasks
     with machine k's capacity removed. Returns one result per candidate
     (lower objective = cheaper drain)."""
-    machine_indices = np.asarray(machine_indices, np.int64)
+    machine_indices = np.asarray(machine_indices, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
     K = len(machine_indices)
     C, M = cluster.C, cluster.M
     if K and (machine_indices.min() < 0 or machine_indices.max() >= M):
@@ -219,7 +219,7 @@ def drain_scenarios(cluster, machine_indices) -> ScenarioBatchResult:
 def surge_scenarios(cluster, extra_supply: np.ndarray) -> ScenarioBatchResult:
     """Score admission headroom: scenario k adds extra_supply[k] (per
     class) to the current backlog against today's free capacity."""
-    extra_supply = np.asarray(extra_supply, np.int64)
+    extra_supply = np.asarray(extra_supply, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
     K = extra_supply.shape[0]
     C, M = cluster.C, cluster.M
     assert extra_supply.shape == (K, C)

@@ -159,7 +159,7 @@ def save_bulk_checkpoint(cluster, path: str) -> None:
     meta = np.array(
         [cluster.M, cluster.P, cluster.S, cluster.J, cluster.C,
          cluster.unsched_cost, cluster.ec_cost, cluster.task_cap],
-        dtype=np.int64,
+        dtype=np.int64,  # kschedlint: host-only (checkpoint wire format)
     )
     arrays = {name: getattr(cluster, name) for name in _BULK_ARRAYS}
     np.savez_compressed(path, __meta__=meta, **arrays)

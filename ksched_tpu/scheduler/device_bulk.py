@@ -1396,7 +1396,7 @@ class DeviceBulkCluster:
 
         def complete(state: DeviceClusterState, rows, count):
             """Retire `count` task rows (first `count` entries of `rows`)."""
-            k = jnp.arange(Tcap)
+            k = jnp.arange(Tcap, dtype=jnp.int32)
             sel = k < count
             idx = jnp.where(sel, rows, Tcap)
             done = jnp.zeros(Tcap + 1, jnp.bool_).at[idx].set(True)[:Tcap]
@@ -1706,7 +1706,7 @@ class DeviceBulkCluster:
         def _vec(name, val, cur, index_range=None):
             if val is None:
                 return cur
-            a = np.asarray(val, np.int64)
+            a = np.asarray(val, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
             if a.shape != (self.G,):
                 raise ValueError(f"{name} must have shape ({self.G},), got {a.shape}")
             if index_range is not None:
@@ -1724,7 +1724,7 @@ class DeviceBulkCluster:
 
         pw = self.groups.pref_w
         if pref_w is not None:
-            a = np.asarray(pref_w, np.int64)
+            a = np.asarray(pref_w, np.int64)  # kschedlint: host-only (host staging; cast at the jit boundary)
             if a.shape != (self.G, self.M):
                 raise ValueError(
                     f"pref_w must have shape ({self.G}, {self.M}), got {a.shape}"

@@ -95,7 +95,7 @@ def build_ell_plan(
 ) -> EllPlan:
     n = num_nodes
     m = len(src)
-    node = np.concatenate([src, dst]).astype(np.int64)
+    node = np.concatenate([src, dst]).astype(np.int64)  # kschedlint: host-only (numpy plan build)
     peer = np.concatenate([dst, src]).astype(np.int32)
     arc = np.concatenate([np.arange(m), np.arange(m)]).astype(np.int32)
     sign = np.concatenate(
@@ -104,9 +104,9 @@ def build_ell_plan(
     deg = np.bincount(node, minlength=n)
     # in-node rank of every doubled entry, via stable node sort
     order = np.argsort(node, kind="stable")
-    row_ptr = np.zeros(n + 1, np.int64)
+    row_ptr = np.zeros(n + 1, np.int64)  # kschedlint: host-only (numpy plan build)
     row_ptr[1:] = np.cumsum(deg)
-    rank = np.empty(2 * m, np.int64)
+    rank = np.empty(2 * m, np.int64)  # kschedlint: host-only (numpy plan build)
     rank[order] = np.arange(2 * m) - row_ptr[node[order]]
 
     is_hub_node = deg > w_small
@@ -114,15 +114,15 @@ def build_ell_plan(
     hub_ids = np.nonzero(is_hub_node)[0]
     ns = max(len(small_ids), 1)
     hn = max(len(hub_ids), 1)
-    small_slot = np.full(n, 0, np.int64)
+    small_slot = np.full(n, 0, np.int64)  # kschedlint: host-only (numpy plan build)
     small_slot[small_ids] = np.arange(len(small_ids))
-    hub_slot = np.full(n, 0, np.int64)
+    hub_slot = np.full(n, 0, np.int64)  # kschedlint: host-only (numpy plan build)
     hub_slot[hub_ids] = np.arange(len(hub_ids))
 
     # hub row allocation: ceil(deg/w_hub) consecutive rows per hub
-    hub_deg = deg[hub_ids] if len(hub_ids) else np.zeros(0, np.int64)
+    hub_deg = deg[hub_ids] if len(hub_ids) else np.zeros(0, np.int64)  # kschedlint: host-only (numpy plan build)
     rows_per_hub = (hub_deg + w_hub - 1) // w_hub
-    hub_row_start = np.zeros(len(hub_ids) + 1, np.int64)
+    hub_row_start = np.zeros(len(hub_ids) + 1, np.int64)  # kschedlint: host-only (numpy plan build)
     hub_row_start[1:] = np.cumsum(rows_per_hub)
     rh = max(int(hub_row_start[-1]), 1)
     kmax = max(int(rows_per_hub.max()) if len(rows_per_hub) else 0, 1)
@@ -159,7 +159,7 @@ def build_ell_plan(
     h_peer[hrow, hcol] = peer[e_hub]
 
     # flat position of every doubled entry in concat([small, hub]) order
-    flat = np.empty(2 * m, np.int64)
+    flat = np.empty(2 * m, np.int64)  # kschedlint: host-only (numpy plan build)
     flat[e_small] = srow * w_small + scol
     flat[e_hub] = ns * w_small + hrow * w_hub + hcol
 
@@ -453,7 +453,7 @@ class EllSolver(FlowSolver):
         problem, fut, rest, _ = pending
         if fut is None:
             return FlowResult(
-                flow=np.zeros(len(problem.src), dtype=np.int64),
+                flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
         flow, p, steps, converged, p_overflow = fut
@@ -481,10 +481,10 @@ class EllSolver(FlowSolver):
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
         objective = int(
-            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         ) + lower_bound_cost(problem)
         return FlowResult(
-            flow=flow_np.astype(np.int64), objective=objective,
+            flow=flow_np.astype(np.int64), objective=objective,  # kschedlint: host-only (FlowResult contract is int64)
             iterations=int(steps),
         )
 

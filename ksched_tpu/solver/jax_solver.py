@@ -92,7 +92,7 @@ def build_csr_plan(src: np.ndarray, dst: np.ndarray, num_nodes: int) -> CsrPlan:
     s_sign = np.where(order < m, 1, -1).astype(np.int32)
     inv_order = np.empty(2 * m, dtype=np.int32)
     inv_order[order] = np.arange(2 * m, dtype=np.int32)
-    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)  # kschedlint: host-only (numpy plan build (row_ptr of 2M entries can exceed int32 in principle))
     counts = np.bincount(s_src, minlength=num_nodes)
     row_ptr[1:] = np.cumsum(counts)
     s_segstart = row_ptr[s_src].astype(np.int32)
@@ -373,7 +373,7 @@ class JaxSolver(FlowSolver):
         problem, fut, rest, _ = pending
         if fut is None:
             return FlowResult(
-                flow=np.zeros(len(problem.src), dtype=np.int64),
+                flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
         flow, p, steps, converged, p_overflow = fut
@@ -401,9 +401,9 @@ class JaxSolver(FlowSolver):
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
         objective = int(
-            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         ) + lower_bound_cost(problem)
-        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))
+        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))  # kschedlint: host-only (FlowResult contract is int64)
 
     def solve(self, problem: FlowProblem) -> FlowResult:
         return self.complete(self.solve_async(problem))

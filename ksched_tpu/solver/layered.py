@@ -65,7 +65,7 @@ def validate_job_unsched_cost(job_unsched_cost, num_jobs: int):
     and tests so the three call sites cannot drift."""
     if job_unsched_cost is None:
         return None
-    out = np.asarray(job_unsched_cost, np.int64)
+    out = np.asarray(job_unsched_cost, np.int64)  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
     if out.shape != (num_jobs,):
         raise ValueError(
             f"job_unsched_cost must have shape ({num_jobs},), got {out.shape}"
@@ -735,10 +735,10 @@ def solve_row_constant(v, supply, col_cap):
 
 def solve_row_constant_np(v, supply, col_cap):
     """Host (numpy) twin of solve_row_constant."""
-    cap_real = col_cap[:-1].astype(np.int64)
+    cap_real = col_cap[:-1].astype(np.int64)  # kschedlint: host-only (host greedy decode)
     cap_total = int(cap_real.sum())
     order = np.argsort(v, kind="stable")
-    sup_s = supply[order].astype(np.int64)
+    sup_s = supply[order].astype(np.int64)  # kschedlint: host-only (host greedy decode)
     take_s = np.where(v[order] < 0, sup_s, 0)
     excl = np.cumsum(take_s) - take_s
     q_s = np.clip(cap_total - excl, 0, take_s)
@@ -750,7 +750,7 @@ def solve_row_constant_np(v, supply, col_cap):
     y_s = np.maximum(hi - lo, 0)
     y_real = np.empty_like(y_s)
     y_real[order] = y_s
-    esc = supply.astype(np.int64) - y_real.sum(axis=1)
+    esc = supply.astype(np.int64) - y_real.sum(axis=1)  # kschedlint: host-only (host greedy decode)
     return np.concatenate([y_real, esc[:, None]], axis=1)
 
 
@@ -778,7 +778,7 @@ def solve_single_class(w, supply, col_cap):
 
 def solve_single_class_np(w: np.ndarray, supply: int, col_cap: np.ndarray) -> np.ndarray:
     """Host (numpy) twin of solve_single_class."""
-    take = np.where(w < 0, col_cap, 0).astype(np.int64)
+    take = np.where(w < 0, col_cap, 0).astype(np.int64)  # kschedlint: host-only (host closed-form decode)
     order = np.argsort(w, kind="stable")
     take_s = take[order]
     excl = np.cumsum(take_s) - take_s
@@ -1011,25 +1011,25 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
     pad(M, C) -> (Mp, n_scale); solve(wS, supply, col_cap, eps_init)
     -> (y, steps, converged) on device arrays."""
     C, M = lp.cost_cm.shape
-    supply = lp.supply.astype(np.int64)
+    supply = lp.supply.astype(np.int64)  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
     total = int(supply.sum())
     if total == 0:
         return LayeredResult(
-            y=np.zeros((C, M), np.int64), num_unsched=0, objective=0, supersteps=0
+            y=np.zeros((C, M), np.int64), num_unsched=0, objective=0, supersteps=0  # kschedlint: host-only (LayeredResult contract is int64)
         )
     # Shifted per-unit cost: placing costs (e + cost[c,m]), leaving
     # unscheduled costs u (per row when row_unsched_cost is set);
     # subtract u so the unsched column is 0 for every row.
     if lp.row_unsched_cost is not None:
-        u_row = np.asarray(lp.row_unsched_cost, np.int64)
+        u_row = np.asarray(lp.row_unsched_cost, np.int64)  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
         assert u_row.shape == (C,), f"row_unsched_cost must be [{C}]"
     else:
-        u_row = np.full(C, int(lp.unsched_cost), np.int64)
-    w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - u_row[:, None]
+        u_row = np.full(C, int(lp.unsched_cost), np.int64)  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
+    w = lp.cost_cm.astype(np.int64) + int(lp.ec_cost) - u_row[:, None]  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
     Mp, n_scale = pad(M, C)
-    wP = np.zeros((C, Mp), np.int64)
+    wP = np.zeros((C, Mp), np.int64)  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
     wP[:, :M] = w
-    col_cap = np.zeros(Mp, np.int64)
+    col_cap = np.zeros(Mp, np.int64)  # kschedlint: host-only (host cost prep; overflow-guarded before the i32 cast)
     col_cap[:M] = lp.col_cap
     col_cap[-1] = total
 
@@ -1084,12 +1084,12 @@ def solve_layered_host(lp: LayeredProblem, *, pad, solve,
                 f"layered transport solve did not converge in "
                 f"{max_supersteps} supersteps"
             )
-        y_np = np.asarray(y).astype(np.int64)
+        y_np = np.asarray(y).astype(np.int64)  # kschedlint: host-only (host decode of device results)
     y_real = y_np[:, :M]
     placed = int(y_real.sum())
     unplaced_row = supply - y_real.sum(axis=1)
     objective = int((u_row * unplaced_row).sum()) + int(
-        ((lp.cost_cm.astype(np.int64) + int(lp.ec_cost)) * y_real).sum()
+        ((lp.cost_cm.astype(np.int64) + int(lp.ec_cost)) * y_real).sum()  # kschedlint: host-only (int64 objective math on host)
     )
     return LayeredResult(
         y=y_real,

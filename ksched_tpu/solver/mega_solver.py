@@ -97,7 +97,7 @@ def build_mega_plan(plan: CsrPlan, lanes: Optional[int] = None) -> MegaPlan:
 
     # partner permutation: entry (u, v) of arc a pairs with (v, u) —
     # the fwd entry's twin is original entry a + m, and vice versa
-    ppos = np.arange(E, dtype=np.int64)
+    ppos = np.arange(E, dtype=np.int64)  # kschedlint: host-only (numpy plan build)
     ppos[:m2] = plan.inv_order[
         np.where(plan.s_sign > 0, plan.s_arc + m, plan.s_arc)
     ]
@@ -313,7 +313,7 @@ class MegaSolver(FlowSolver):
             return res
         if fut is None:
             return FlowResult(
-                flow=np.zeros(len(problem.src), dtype=np.int64),
+                flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
         flow, steps, converged, p_overflow = fut
@@ -343,10 +343,10 @@ class MegaSolver(FlowSolver):
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
         objective = int(
-            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()
+            (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         ) + lower_bound_cost(problem)
         return FlowResult(
-            flow=flow_np.astype(np.int64), objective=objective,
+            flow=flow_np.astype(np.int64), objective=objective,  # kschedlint: host-only (FlowResult contract is int64)
             iterations=int(steps),
         )
 

@@ -8,7 +8,7 @@ the JAX backend instead of a traceback.
 
 from __future__ import annotations
 
-import sys
+import warnings
 
 from .base import FlowSolver
 
@@ -16,7 +16,8 @@ from .base import FlowSolver
 def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> FlowSolver:
     """name: "native" | "jax" | "ell" | "mega" | "ref" | "layered" |
     "auto". With fallback=True a failed native build degrades to the
-    JAX solver with a stderr note."""
+    JAX solver with a RuntimeWarning (capturable by callers/tests via
+    warnings.catch_warnings, unlike the stderr print it replaced)."""
     if name == "native":
         try:
             from .native import NativeSolver
@@ -25,7 +26,11 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         except (RuntimeError, OSError, FileNotFoundError) as e:
             if not fallback:
                 raise
-            print(f"# native backend unavailable ({e}); using jax", file=sys.stderr)
+            warnings.warn(
+                f"native backend unavailable ({e}); using jax",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             name = "jax"
     if name == "jax":
         from .jax_solver import JaxSolver

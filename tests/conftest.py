@@ -25,6 +25,14 @@ import pytest  # noqa: E402
 from ksched_tpu.utils import seed_rng  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests excluded from the budgeted tier-1 "
+        "selection (-m 'not slow'); run them with a plain `pytest tests/`",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _seeded_rng():
     seed_rng(42)

@@ -1,0 +1,133 @@
+"""Malformed DIMACS input must fail loudly with the offending line —
+never decode into a mis-placed flow (ISSUE 3 satellite).
+
+The text codec is the interop seam with external solvers
+(graph/dimacs.py): a truncated pipe or a corrupted line that parsed
+"successfully" would feed garbage arc/flow data straight into the
+device arrays indexed by these ids.
+"""
+
+import io
+
+import pytest
+
+from ksched_tpu.graph.dimacs import export, parse_flow, parse_graph
+from ksched_tpu.graph.flowgraph import FlowGraph
+
+GOOD = """\
+c a well-formed instance
+p min 4 3
+n 1 2
+n 4 -2
+a 1 2 0 2 5
+a 2 4 0 2 0
+a 1 4 0 1 9
+c EOI
+"""
+
+
+def test_well_formed_parses():
+    header, nodes, arcs = parse_graph(io.StringIO(GOOD))
+    assert header == (4, 3)
+    assert len(nodes) == 2 and len(arcs) == 3
+    assert arcs[0] == (1, 2, 0, 2, 5)
+
+
+def test_roundtrip_with_real_graph():
+    g = FlowGraph()
+    a, b = g.add_node(), g.add_node()
+    arc = g.add_arc(a, b)
+    arc.cap_upper = 3
+    arc.cost = 7
+    a.excess, b.excess = 1, -1
+    buf = io.StringIO()
+    export(g, buf)
+    header, nodes, arcs = parse_graph(io.StringIO(buf.getvalue()))
+    assert header == (2, 1)
+    assert (a.id, b.id, 0, 3, 7) in arcs
+
+
+@pytest.mark.parametrize("bad_line,match", [
+    ("a 1 2 0 2", "truncated arc line"),
+    ("a 1 2", "truncated arc line"),
+    ("a 1 2 0 -2 5", "negative capacity"),
+    ("a 1 2 -1 2 5", "negative capacity"),
+    ("a 1 2 3 2 5", "below lower bound"),
+    ("a 1 9 0 2 5", "out of range"),
+    ("a 9 2 0 2 5", "out of range"),
+    ("a -3 2 0 2 5", "out of range"),
+    ("a 1 2 0 x 5", "non-integer"),
+    ("n 1", "truncated node line"),
+    ("n 9 2", "out of range"),
+    ("n -1 2", "out of range"),
+    ("q 1 2", "unknown record type"),
+])
+def test_malformed_lines_raise(bad_line, match):
+    text = GOOD.replace("a 1 4 0 1 9", bad_line)
+    with pytest.raises(ValueError, match=match):
+        parse_graph(io.StringIO(text))
+
+
+def test_records_before_header_raise():
+    with pytest.raises(ValueError, match="before `p min` header"):
+        parse_graph(io.StringIO("n 1 2\np min 4 3\n"))
+    with pytest.raises(ValueError, match="before `p min` header"):
+        parse_graph(io.StringIO("a 1 2 0 2 5\np min 4 3\n"))
+
+
+def test_malformed_header_raises():
+    with pytest.raises(ValueError, match="malformed header"):
+        parse_graph(io.StringIO("p max 4 3\n"))
+    with pytest.raises(ValueError, match="malformed header"):
+        parse_graph(io.StringIO("p min 4\n"))
+    with pytest.raises(ValueError, match="negative extent"):
+        parse_graph(io.StringIO("p min -4 3\n"))
+
+
+def test_stream_without_terminator_raises():
+    # a cut pipe dropping the tail (incl. `c EOI`) must not decode as
+    # a partial graph
+    with pytest.raises(ValueError, match="no 'c EOI' terminator"):
+        parse_graph(io.StringIO(GOOD.replace("c EOI\n", "")))
+
+
+def test_stream_with_missing_arcs_raises():
+    truncated = GOOD.replace("a 1 4 0 1 9\n", "")  # EOI intact, one arc lost
+    with pytest.raises(ValueError, match="declares 3 arcs, got 2"):
+        parse_graph(io.StringIO(truncated))
+
+
+def test_error_names_the_line_number():
+    text = "p min 4 3\nn 1 2\na 1 2 0 2\n"
+    with pytest.raises(ValueError, match="line 3"):
+        parse_graph(io.StringIO(text))
+
+
+# -- flow responses ----------------------------------------------------------
+
+
+def test_flow_response_truncated_line_raises():
+    with pytest.raises(ValueError, match="truncated flow line"):
+        parse_flow(io.StringIO("f 1 2\nc EOI\n"))
+
+
+def test_flow_response_non_integer_raises():
+    with pytest.raises(ValueError, match="non-integer"):
+        parse_flow(io.StringIO("f 1 2 x\nc EOI\n"))
+
+
+def test_flow_response_trailing_fields_raise():
+    # `f 1 2 3 5` for an intended flow 35 must not decode as flow 3
+    with pytest.raises(ValueError, match="trailing fields"):
+        parse_flow(io.StringIO("f 1 2 3 5\nc EOI\n"))
+
+
+def test_flow_response_negative_flow_raises():
+    with pytest.raises(ValueError, match="negative flow"):
+        parse_flow(io.StringIO("f 1 2 -1\nc EOI\n"))
+
+
+def test_flow_response_missing_terminator_still_raises():
+    # pre-existing contract (a dead solver must not decode partially)
+    with pytest.raises(ValueError, match="truncated"):
+        parse_flow(io.StringIO("f 1 2 1\n"))

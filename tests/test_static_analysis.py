@@ -1,0 +1,373 @@
+"""Tier-1 gate for the static-analysis suite (ksched_tpu/analysis/).
+
+Level 1: the AST lint must be clean over the whole tree (zero
+unsuppressed, unbaselined violations), and every rule must actually
+fire on a seeded bad snippet — a lint that silently stopped matching
+is worse than no lint.
+
+Level 2: the jaxpr contracts hold for every registered backend at 3
+representative shape buckets — no 64-bit converts, no scatters, the
+megakernel's zero-HBM-gather budget, pow2-bucket jaxpr-hash stability,
+and the VMEM estimate consistent with `mega_fits_vmem` — plus negative
+tests proving each contract detects a seeded violation.
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from ksched_tpu.analysis import (
+    RULES,
+    lint_paths,
+    load_baseline,
+    split_by_baseline,
+)
+from ksched_tpu.analysis.ast_rules import lint_source
+from ksched_tpu.analysis import jaxpr_contracts as jc
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_TARGETS = ["ksched_tpu", "tools", "bench.py"]
+
+#: 3 representative (n, m) shape buckets — interpreted as (C, M) by the
+#: layered backend — small enough that abstract tracing stays cheap
+SHAPE_BUCKETS = [(12, 40), (20, 100), (40, 220)]
+
+#: raw-size pairs sharing a pow2 bucket, per hash-stable backend:
+#: (n pads 16/32/64..., m pads to next_pow2(max(.,16)); layered M pads
+#: to a multiple of 128 via pad_geometry with C untouched)
+BUCKET_PAIRS = {
+    "jax": [((12, 40), (15, 60)), ((20, 100), (30, 70)), ((40, 220), (60, 200))],
+    "mega": [((12, 40), (15, 60)), ((20, 100), (30, 70)), ((40, 220), (60, 200))],
+    "layered": [((4, 40), (4, 100)), ((4, 130), (4, 250)), ((8, 300), (8, 370))],
+}
+
+#: and pairs in DIFFERENT buckets, which must produce different jaxprs
+#: (otherwise the stability check is vacuous)
+CROSS_BUCKET_PAIRS = {
+    "jax": ((12, 40), (12, 200)),
+    "mega": ((12, 40), (12, 2000)),
+    "layered": ((4, 40), (4, 300)),
+}
+
+
+# ---------------------------------------------------------------------------
+# Level 1: the repo is lint-clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    violations = lint_paths(LINT_TARGETS, repo_root=REPO_ROOT)
+    baseline = load_baseline(os.path.join(REPO_ROOT, "tools", "kschedlint_baseline.json"))
+    new, _old, _stale = split_by_baseline(violations, baseline)
+    assert not new, "new kschedlint violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_baseline_is_empty():
+    """The ratchet starts clean: every seed violation was fixed or
+    suppressed inline with a rationale (ISSUE 3 acceptance)."""
+    with open(os.path.join(REPO_ROOT, "tools", "kschedlint_baseline.json")) as fh:
+        data = json.load(fh)
+    assert data["violations"] == []
+
+
+def test_cli_exits_zero():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kschedlint", "ksched_tpu", "tools", "bench.py"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Level 1: every rule fires on a seeded bad snippet
+# ---------------------------------------------------------------------------
+
+BAD_SNIPPETS = {
+    "dtype64": """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def prep(n):
+            a = np.zeros(n, dtype=np.int64)
+            b = a.astype("float64")
+            return jnp.asarray(a), b
+    """,
+    "implicit-dtype": """
+        import jax.numpy as jnp
+
+        def build(n):
+            return jnp.zeros(n), jnp.arange(n), jnp.full((n,), 3)
+    """,
+    "jit-static": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("alpha",))
+        def solve(x, alpha: int = 8, max_steps: int = 100):
+            return x * alpha
+    """,
+    "traced-branch": """
+        import jax
+
+        @jax.jit
+        def f(x, flag):
+            if flag > 0:
+                return x + 1
+            while x:
+                x = x - 1
+            return x
+    """,
+    "mutable-default": """
+        def accumulate(item, acc=[]):
+            acc.append(item)
+            return acc
+    """,
+    "bare-except": """
+        def risky():
+            try:
+                return 1
+            except:
+                return 0
+    """,
+    "raw-print": """
+        def report(msg):
+            print(msg)
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_fires_on_bad_snippet(rule):
+    source = textwrap.dedent(BAD_SNIPPETS[rule])
+    # lint under a library path so library-scoped rules apply
+    violations = lint_source(f"ksched_tpu/_snippet_{rule.replace('-', '_')}.py", source)
+    assert any(v.rule == rule for v in violations), (
+        f"rule {rule} did not fire; got {[v.rule for v in violations]}"
+    )
+
+
+def test_suppression_comment_silences_rule():
+    source = (
+        "import numpy as np\nimport jax\n"
+        "x = np.zeros(4, dtype=np.int64)  # kschedlint: host-only (test)\n"
+        "print('hi')  # kschedlint: disable=raw-print -- test\n"
+    )
+    assert lint_source("ksched_tpu/_snippet_suppress.py", source) == []
+
+
+def test_suppression_does_not_leak_to_other_rules():
+    source = (
+        "import numpy as np\nimport jax\n"
+        "x = np.zeros(4, dtype=np.int64)  # kschedlint: disable=raw-print\n"
+    )
+    assert [v.rule for v in lint_source("ksched_tpu/_s.py", source)] == ["dtype64"]
+
+
+def test_baseline_is_a_multiset():
+    """One baselined entry waives ONE occurrence: copy-pasting an
+    accepted bad line elsewhere in the file still fails the gate."""
+    from ksched_tpu.analysis.baseline import fingerprint as fp
+
+    source = (
+        "import numpy as np\nimport jax\n"
+        "a = np.zeros(4, dtype=np.int64)\n"
+        "b = np.zeros(4, dtype=np.int64)\n"
+    )
+    from collections import Counter
+
+    violations = lint_source("ksched_tpu/_dup.py", source)
+    assert len(violations) == 2
+    e = fp(violations[0])
+    baseline = Counter([(e["path"], e["rule"], e["hash"])])
+    new, old, stale = split_by_baseline(violations, baseline)
+    assert len(old) == 1 and len(new) == 1 and not stale
+
+
+def test_unparsable_file_reports_syntax_error_violation():
+    """A half-written .py must fail the gate with a clean diagnostic,
+    not an ast.parse traceback."""
+    violations = lint_source("ksched_tpu/_broken.py", "def f(:\n")
+    assert [v.rule for v in violations] == ["syntax-error"]
+    assert "does not parse" in violations[0].message
+
+
+def test_is_none_branch_is_not_flagged():
+    source = textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, pm0=None):
+            if pm0 is None:
+                pm0 = jnp.zeros_like(x)
+            return x + pm0
+    """)
+    assert not any(
+        v.rule == "traced-branch"
+        for v in lint_source("ksched_tpu/_s.py", source)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Level 2: jaxpr contracts for every registered backend
+# ---------------------------------------------------------------------------
+
+
+def test_backend_registry_matches_select():
+    """The contract suite must trace what select.py can hand out: every
+    in-process array backend name in make_backend appears here."""
+    with open(os.path.join(REPO_ROOT, "ksched_tpu", "solver", "select.py")) as fh:
+        select_src = fh.read()
+    for name in ("jax", "ell", "mega", "layered"):
+        assert f'name == "{name}"' in select_src
+        assert name in jc.REGISTERED_BACKENDS
+    assert "sharded" in jc.REGISTERED_BACKENDS  # parallel/sharded_*
+
+
+@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
+@pytest.mark.parametrize("backend", jc.REGISTERED_BACKENDS)
+def test_contracts_no_64bit_no_scatter(backend, bucket):
+    report = jc.backend_report(backend, *bucket)
+    assert report.ok_64bit, report.violations_64bit
+    assert report.ok_scatter, report.scatter_eqns
+    assert report.num_eqns > 0
+
+
+@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
+def test_mega_gather_budget_zero(bucket):
+    """PR 1's claim, locked in: zero per-superstep HBM gathers. The
+    loop lives inside the pallas_call whose operands are all VMEM/SMEM
+    by BlockSpec; in-kernel gathers are exactly the pinned partner-
+    permutation reads; outside the kernel, gathers only run once per
+    solve (the entry materialization), never inside a loop."""
+    report = jc.backend_report("mega", *bucket)
+    assert report.hbm_loop_gathers == 0
+    assert report.kernel_gathers == jc.MEGA_KERNEL_PERM_GATHERS
+    est = jc.estimate_mega_vmem(jc.traced("mega", *bucket))
+    assert est.all_operands_on_chip
+
+
+def test_csr_backend_shows_the_contrast():
+    """The scan-CSR backend pays per-superstep HBM gathers (that is
+    the megakernel's whole reason to exist) — if this ever reads 0 the
+    gather classifier is broken, not the solver fixed."""
+    report = jc.backend_report("jax", 20, 100)
+    assert report.hbm_loop_gathers > 0
+
+
+@pytest.mark.parametrize("backend", sorted(BUCKET_PAIRS))
+def test_pow2_bucket_jaxpr_hash_stable(backend):
+    for raw_a, raw_b in BUCKET_PAIRS[backend]:
+        ha, hb = jc.recompile_hazard(backend, raw_a, raw_b)
+        assert ha == hb, (
+            f"{backend}: raw sizes {raw_a} and {raw_b} share a pow2 bucket "
+            "but trace different jaxprs — a raw size is leaking into the "
+            "traced program (recompile hazard)"
+        )
+    raw_a, raw_b = CROSS_BUCKET_PAIRS[backend]
+    ha, hb = jc.recompile_hazard(backend, raw_a, raw_b)
+    assert ha != hb, "cross-bucket hashes collide; the stability check is vacuous"
+
+
+@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
+def test_mega_vmem_estimate_consistent_with_gate(bucket):
+    from ksched_tpu.ops.mcmf_pallas import (
+        _MEGA_VMEM_BUDGET_BYTES,
+        MEGA_LANES,
+        mega_entry_rows,
+        mega_fits_vmem,
+    )
+
+    est = jc.estimate_mega_vmem(jc.traced("mega", *bucket))
+    assert est.L == MEGA_LANES
+    assert est.gate_is_safe, (
+        f"kernel live set ({est.est_tiles} tiles) exceeds the "
+        f"_MEGA_LIVE_TILES gate ({est.gate_tiles}): mega_fits_vmem would "
+        "admit solves that cannot be VMEM-resident — raise the gate"
+    )
+    assert est.gate_is_tight, (
+        f"gate ({est.gate_tiles} tiles) is far above the counted live set "
+        f"({est.est_tiles}): it has drifted from the kernel it guards"
+    )
+    # the gate refuses exactly where the counted estimate exceeds budget
+    for entries in (512, 1 << 15, 1 << 18, 1 << 20, 1 << 22):
+        padded = mega_entry_rows(entries) * MEGA_LANES
+        counted_fits = est.gate_tiles * padded * 4 <= _MEGA_VMEM_BUDGET_BYTES
+        assert mega_fits_vmem(entries) == counted_fits
+
+
+# ---------------------------------------------------------------------------
+# Level 2: negative tests — each contract detects a seeded violation
+# ---------------------------------------------------------------------------
+
+
+def _make_jaxpr(fn, *shapes):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.make_jaxpr(fn)(
+        *(jax.ShapeDtypeStruct(s, jnp.int32) for s in shapes)
+    )
+
+
+def test_contract_catches_64bit_convert():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x):
+        return x.astype(jnp.float64).sum()
+
+    # without x64, jax downcasts the seeded violation to f32 before the
+    # checker could see it — exactly why the contract exists: if anyone
+    # flips x64 on, 64-bit types flow silently
+    with jax.experimental.enable_x64():
+        closed = _make_jaxpr(bad, (8,))
+    report = jc.check_jaxpr("bad", closed)
+    assert not report.ok_64bit
+
+
+def test_contract_catches_scatter():
+    def bad(x, idx):
+        return x.at[idx].add(1)
+
+    report = jc.check_jaxpr("bad", _make_jaxpr(bad, (8,), (3,)))
+    assert not report.ok_scatter
+
+
+def test_contract_catches_loop_gather():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def bad(x, idx):
+        def body(_, carry):
+            return carry + x[idx].sum()
+
+        return lax.fori_loop(0, 4, body, jnp.int32(0))
+
+    report = jc.check_jaxpr("bad", _make_jaxpr(bad, (8,), (3,)))
+    assert report.hbm_loop_gathers > 0
+
+
+def test_contract_catches_bucket_leak():
+    """A raw size leaking into a static arg splits the jaxpr hash —
+    the exact failure mode of a forgotten pow2 pad."""
+    import functools
+    import jax
+
+    def leaky(x, scale: int = 1):
+        return x * scale
+
+    def trace(m_raw):
+        fn = functools.partial(leaky, scale=m_raw)  # raw size as static
+        return _make_jaxpr(fn, (64,))
+
+    assert jc.jaxpr_hash(trace(40)) != jc.jaxpr_hash(trace(60))

@@ -1,0 +1,2 @@
+# Makes tools/ a package so `python -m tools.kschedlint` resolves from
+# any sys.path configuration (namespace-package lookup is cwd-dependent).

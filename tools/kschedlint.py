@@ -1,0 +1,100 @@
+"""kschedlint: the repo's AST lint CLI (Level 1 of ksched_tpu.analysis).
+
+Usage:
+    python -m tools.kschedlint ksched_tpu tools bench.py
+    python -m tools.kschedlint --write-baseline ksched_tpu tools bench.py
+
+Exit status: 0 when every violation is suppressed inline or recorded in
+the baseline; 1 when NEW violations exist (printed one per line as
+`path:line:col: rule: message`); 2 on usage errors. Stale baseline
+entries (fixed violations still listed) are reported as a warning —
+run --write-baseline to shed them.
+
+The jaxpr contracts (Level 2) need jax and are run by
+tests/test_static_analysis.py, not this CLI, so the lint stays usable
+in environments without the jax_graft toolchain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python tools/kschedlint.py` direct invocation
+    sys.path.insert(0, _REPO_ROOT)
+
+from ksched_tpu.analysis import (  # noqa: E402
+    RULES,
+    lint_paths,
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join("tools", "kschedlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kschedlint", description=__doc__)
+    parser.add_argument("paths", nargs="*", default=["ksched_tpu", "tools", "bench.py"],
+                        help="files/directories to lint (default: the library, "
+                        "tools, and bench.py)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON (repo-relative)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: every violation fails")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept current violations into the baseline and exit 0")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--root", default=_REPO_ROOT,
+                        help="repo root paths are resolved against")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, fn in RULES.items():
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"{name:16s} {doc}")
+        return 0
+
+    for p in args.paths:
+        # os.path.join passes absolute p through untouched, so this
+        # also rejects a typo'd absolute path instead of "cleanly"
+        # linting zero files
+        if not os.path.exists(os.path.join(args.root, p)):
+            print(f"kschedlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    violations = lint_paths(args.paths, repo_root=args.root)
+    baseline_path = os.path.join(args.root, args.baseline)
+
+    if args.write_baseline:
+        count = write_baseline(baseline_path, violations)
+        print(f"kschedlint: baseline written with {count} entr{'y' if count == 1 else 'ies'}")
+        return 0
+
+    from collections import Counter
+
+    baseline = Counter() if args.no_baseline else load_baseline(baseline_path)
+    new, old, stale = split_by_baseline(violations, baseline)
+
+    for v in new:
+        print(v.render())
+    if old:
+        print(f"kschedlint: {len(old)} baselined violation(s) not shown "
+              f"(ratchet debt in {args.baseline})", file=sys.stderr)
+    if stale:
+        print(f"kschedlint: {sum(stale.values())} stale baseline entr(y/ies) — "
+              "the violations were fixed; run --write-baseline to shed them",
+              file=sys.stderr)
+    if new:
+        print(f"kschedlint: {len(new)} new violation(s)", file=sys.stderr)
+        return 1
+    print(f"kschedlint: clean ({len(old)} baselined, "
+          f"{len(list(RULES))} rules)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
