@@ -1,20 +1,28 @@
 # CI entry points — `make verify` is the PR gate (lint + tier-1 tests).
 #
-#   make lint      kschedlint AST rules over the library, tools, bench
-#   make test      tier-1 pytest (ROADMAP.md command; CPU, 8-dev mesh)
-#   make verify    lint, then tests
-#   make baseline  re-accept current lint violations (ratchet; avoid —
-#                  fix or suppress inline instead, docs/static_analysis.md)
+#   make lint         kschedlint AST rules over the library, tools, bench
+#   make test         tier-1 pytest (ROADMAP.md command; CPU, 8-dev mesh)
+#   make chaos-smoke  short fixed-seed chaos soak (fault injection +
+#                     degradation ladder + restore + determinism check;
+#                     docs/robustness.md)
+#   make verify       lint, then tests, then the chaos smoke
+#   make baseline     re-accept current lint violations (ratchet; avoid —
+#                     fix or suppress inline instead, docs/static_analysis.md)
 
 SHELL := /bin/bash
 
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test verify baseline
+.PHONY: lint test chaos-smoke verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
+
+chaos-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/soak.py --chaos \
+	  --rounds 96 --chunk 32 --seed 0 --machines 6 --slots 8 \
+	  --chaos-restore-every 48 --verify-determinism
 
 test:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -25,7 +33,7 @@ test:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-verify: lint test
+verify: lint test chaos-smoke
 
 baseline:
 	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

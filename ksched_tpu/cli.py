@@ -13,12 +13,16 @@ Run: python -m ksched_tpu.cli --fake-machines --num-machines 10 \
 from __future__ import annotations
 
 import argparse
+import pickle
 import sys
 import threading
 import time
-from typing import Dict, Optional
+import urllib.error
+import warnings
+from typing import Dict, List, Optional, Tuple
 
 from .cluster import Binding, ClusterAPI, NodeEvent, PodEvent, SyntheticClusterAPI
+from .cluster.api import RETRY_STAT_KEYS
 from .costmodels import MODEL_REGISTRY, CostModelType
 from .drivers.synthetic import (
     add_machine,
@@ -26,8 +30,15 @@ from .drivers.synthetic import (
     build_machine_topology,
     make_coordinator_root,
 )
+from .runtime.chaos import FaultInjector, delta_counters
+from .runtime.degrade import DegradingSolver, LadderExhausted, build_degradation_ladder
+from .runtime.failure import HeartbeatMonitor, RoundWatchdog
+from .runtime.trace import RoundTracer
 from .scheduler import FlowScheduler
+from .scheduler.flow_scheduler import RoundTiming
+from .solver.cpu_ref import ReferenceSolver
 from .utils import (
+    ExpBackoff,
     JobMap,
     ResourceMap,
     ResourceStatus,
@@ -36,10 +47,19 @@ from .utils import (
     resource_id_from_string,
 )
 
+#: service-checkpoint sidecar version (the scheduler state itself rides
+#: in runtime/checkpoint.py's save_scheduler format)
+SERVICE_CHECKPOINT_VERSION = 1
+
 
 class SchedulerService:
     """The long-running scheduler process state (reference:
-    cmd/k8sscheduler/scheduler.go:44-87)."""
+    cmd/k8sscheduler/scheduler.go:44-87), hardened: the configured
+    backend rides a degradation ladder (configured → scan-CSR jax →
+    cpu_ref → NOOP round, runtime/degrade.py), rounds run under a
+    deadline watchdog, heartbeat sweeps are integrated into the loop,
+    and every fault / retry / degradation is attributed to its round in
+    the trace (runtime/trace.py RoundRecord)."""
 
     def __init__(
         self,
@@ -47,24 +67,50 @@ class SchedulerService:
         max_tasks_per_pu: int = 1000,
         cost_model: CostModelType = CostModelType.TRIVIAL,
         backend=None,
+        backend_name: str = "configured",
+        degrade: bool = True,
+        injector: Optional[FaultInjector] = None,
+        tracer: Optional[RoundTracer] = None,
+        round_deadline_s: float = 0.0,
+        _restored: Optional[Tuple] = None,
     ) -> None:
         self.api = api
-        self.resource_map = ResourceMap()
-        self.job_map = JobMap()
-        self.task_map = TaskMap()
-        self.root = make_coordinator_root()
-        self.resource_map.insert(
-            resource_id_from_string(self.root.resource_desc.uuid),
-            ResourceStatus(descriptor=self.root.resource_desc, topology_node=self.root),
-        )
-        self.scheduler = FlowScheduler(
-            self.resource_map,
-            self.job_map,
-            self.task_map,
-            self.root,
-            max_tasks_per_pu=max_tasks_per_pu,
-            cost_model_factory=MODEL_REGISTRY[cost_model],
-            backend=backend,
+        self.injector = injector
+        self.tracer = tracer
+        self.watchdog = RoundWatchdog(round_deadline_s)
+        self.monitor: Optional[HeartbeatMonitor] = None
+        if _restored is None:
+            if degrade:
+                backend = build_degradation_ladder(
+                    backend if backend is not None else ReferenceSolver(),
+                    backend_name,
+                    injector=injector,
+                )
+            self.resource_map = ResourceMap()
+            self.job_map = JobMap()
+            self.task_map = TaskMap()
+            self.root = make_coordinator_root()
+            self.resource_map.insert(
+                resource_id_from_string(self.root.resource_desc.uuid),
+                ResourceStatus(descriptor=self.root.resource_desc, topology_node=self.root),
+            )
+            self.scheduler = FlowScheduler(
+                self.resource_map,
+                self.job_map,
+                self.task_map,
+                self.root,
+                max_tasks_per_pu=max_tasks_per_pu,
+                cost_model_factory=MODEL_REGISTRY[cost_model],
+                backend=backend,
+            )
+        else:
+            # restore path: the scheduler was rebuilt by replaying the
+            # checkpoint through the event API (runtime/checkpoint.py)
+            self.scheduler, self.resource_map, self.job_map, self.task_map = _restored
+            self.root = self.scheduler.resource_topology
+        ladder = self.scheduler.solver.backend
+        self.ladder: Optional[DegradingSolver] = (
+            ladder if isinstance(ladder, DegradingSolver) else None
         )
         self.max_tasks_per_pu = max_tasks_per_pu
         # Bidirectional id maps (reference :44-62).
@@ -76,6 +122,21 @@ class SchedulerService:
         self.job_id = rand_uint64()
         self.old_bindings: Dict[int, int] = {}
         self.round_latencies_s: list = []
+        self.noop_rounds = 0
+        #: whether the runnable backlog may need a re-solve on a quiet
+        #: poll (set by NOOP rounds and heartbeat evictions; cleared by
+        #: a successful solve) — run() consults it so steady-state idle
+        #: polls cost a sweep, not a full MCMF solve
+        self.backlog_dirty = False
+        # Persistent attribution marks: faults/retries can fire between
+        # rounds (e.g. at batch-poll time, before run_round is entered),
+        # and must land in the NEXT round's record, never vanish.
+        self._fault_mark: Dict[str, int] = (
+            injector.snapshot() if injector is not None else {}
+        )
+        self._api_stats_mark: Dict[str, int] = (
+            api.stats() if hasattr(api, "stats") else {}
+        )
 
     # -- topology ---------------------------------------------------------
 
@@ -93,6 +154,10 @@ class SchedulerService:
         mid = resource_id_from_string(machine.resource_desc.uuid)
         self.node_to_machine[node.node_id] = mid
         self.machine_to_node[mid] = node.node_id
+        # fresh capacity: wake the quiet-channel loop for a re-solve —
+        # waiting unbound pods must not starve until a new pod arrives
+        if self._has_unbound_pods():
+            self.backlog_dirty = True
 
     def init_topology(
         self,
@@ -117,6 +182,55 @@ class SchedulerService:
         for node in nodes:
             self.add_node(node)
         return len(nodes)
+
+    def enable_heartbeats(
+        self,
+        machine_timeout_s: float = 30.0,
+        task_timeout_s: float = 60.0,
+        clock=None,
+    ) -> HeartbeatMonitor:
+        """Attach a HeartbeatMonitor; run_round then sweeps it every
+        round and cleans the node maps for machines it expires."""
+        self.monitor = HeartbeatMonitor(
+            self.scheduler,
+            machine_timeout_s=machine_timeout_s,
+            task_timeout_s=task_timeout_s,
+            clock=clock,
+        )
+        return self.monitor
+
+    def _has_unbound_pods(self) -> bool:
+        """Known pods whose tasks hold no binding — the backlog fresh
+        node capacity may now admit. O(live pods): fine on the rare
+        node-arrival path, too hot for per-completion use."""
+        bound = self.scheduler.task_bindings
+        return any(tid not in bound for tid in self.pod_to_task.values())
+
+    def _forget_machine(self, machine_rid: int) -> None:
+        """Drop a lost machine from the node↔machine maps (the scheduler
+        side was already deregistered by the heartbeat sweep)."""
+        node_id = self.machine_to_node.pop(machine_rid, None)
+        if node_id is not None and self.node_to_machine.get(node_id) == machine_rid:
+            del self.node_to_machine[node_id]
+
+    def complete_pod(self, pod_id: str) -> bool:
+        """Retire a pod's task through the normal completion path and
+        clean the service maps. False if the pod is unknown or its task
+        is not currently bound (nothing to complete)."""
+        task_id = self.pod_to_task.get(pod_id)
+        if task_id is None or task_id not in self.scheduler.task_bindings:
+            return False
+        td = self.task_map.find(task_id)
+        self.scheduler.handle_task_completion(td)
+        self.pod_to_task.pop(pod_id, None)
+        self.task_to_pod.pop(task_id, None)
+        self.old_bindings.pop(task_id, None)
+        # freed capacity may admit waiting unbound pods: wake the
+        # quiet-channel loop for a re-solve. Unconditional — a spurious
+        # re-solve on the next quiet poll is near-free, while scanning
+        # for unbound pods here would make bulk completion bursts O(n²).
+        self.backlog_dirty = True
+        return True
 
     # -- pod → task -------------------------------------------------------
 
@@ -198,31 +312,274 @@ class SchedulerService:
             self.api.assign_bindings(out)
         return len(out)
 
+    def run_round(
+        self, pods, now: Optional[float] = None, solve: bool = True
+    ) -> int:
+        """One hardened round: run_once under the deadline watchdog with
+        the degradation ladder's NOOP backstop, then a heartbeat sweep,
+        then trace attribution (faults / retries / degradations /
+        expiries → this round's RoundRecord). ``now`` is the heartbeat
+        sweep's injected clock (the chaos soak drives logical time).
+
+        ``solve=False`` is the idle sweep: heartbeat check + trace
+        attribution only, no graph rebuild/solve — run() uses it on
+        quiet polls while the backlog is clean, so a steady-state
+        service costs a sweep per batch timeout, not a full MCMF
+        solve. Recorded with ``solver_rung`` -1 and ``noop_round``
+        False (a NOOP is a *failed* solve; this is a skipped one)."""
+        deg_mark = self.ladder.degradations_total if self.ladder is not None else 0
+        noop = False
+        bound = 0
+        deadline_miss = False
+        if solve:
+            with self.watchdog as wd:
+                try:
+                    bound = self.run_once(pods)
+                except LadderExhausted as e:
+                    # Every rung failed: keep the previous assignments
+                    # and carry on — the backlog stays runnable and the
+                    # next round retries from the configured rung.
+                    noop = True
+                    self.noop_rounds += 1
+                    self.scheduler.last_timing = RoundTiming()
+                    warnings.warn(
+                        f"NOOP round (previous assignments kept): {e}",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+            deadline_miss = wd.fired
+        else:
+            # no solve ran: keep stale phase timings out of the trace
+            self.scheduler.last_timing = RoundTiming()
+        lost: List[int] = []
+        failed: List[int] = []
+        if self.monitor is not None:
+            lost, failed = self.monitor.check(now)
+            for rid in lost:
+                self._forget_machine(rid)
+        # NOOP rounds and evictions leave runnable work behind; a clean
+        # full solve clears it. An idle sweep must not clear the flag —
+        # it did not schedule anything.
+        if noop or lost or failed:
+            self.backlog_dirty = True
+        elif solve:
+            self.backlog_dirty = False
+        if self.tracer is not None:
+            faults = {}
+            if self.injector is not None:
+                snap = self.injector.snapshot()
+                faults = delta_counters(self._fault_mark, snap)
+                self._fault_mark = snap
+            api_stats = self.api.stats() if hasattr(self.api, "stats") else {}
+            # Only retry/re-post counters belong in `retries`; the stats
+            # surface also carries drop counters (binding_drops), which
+            # are a different signal and would silently inflate it.
+            retries = sum(
+                api_stats.get(k, 0) - self._api_stats_mark.get(k, 0)
+                for k in RETRY_STAT_KEYS
+            )
+            self._api_stats_mark = api_stats
+            self.tracer.record_flow_round(
+                self.scheduler,
+                bound,
+                # idle sweeps must not re-report the previous solve's
+                # graph-delta stats and solver work (a NOOP round's
+                # graph update DID run, so it still reports)
+                solved=solve,
+                extra=dict(
+                    faults_injected=faults,
+                    retries=retries,
+                    degradations=(
+                        self.ladder.degradations_total - deg_mark
+                        if self.ladder is not None
+                        else 0
+                    ),
+                    solver_rung=(
+                        -1 if (noop or not solve)
+                        else (self.ladder.last_rung if self.ladder is not None else 0)
+                    ),
+                    noop_round=noop,
+                    deadline_miss=deadline_miss,
+                    machines_lost=len(lost),
+                    tasks_failed=len(failed),
+                ),
+            )
+        return bound
+
     def run(self, pod_batch_timeout_s: float = 2.0, max_rounds: Optional[int] = None) -> None:
+        """The hardened main loop. Exits only when the control plane is
+        actually closed; an empty batch with the channel still open —
+        the signature of a transient API-server outage (or plain quiet)
+        — idles through a sweep-only round instead of exiting, so the
+        scheduler rides out outages and still detects silent machines
+        while no pods arrive. Idle rounds do not count against
+        ``max_rounds`` (which counts scheduling rounds, as before)."""
         rounds = 0
+        tick = 0  # injector rounds: one per loop iteration, idle or not
         while max_rounds is None or rounds < max_rounds:
-            pods = self.api.get_pod_batch(pod_batch_timeout_s)
+            if self.injector is not None:
+                # `tick`, not `rounds`: an idle round is still one full
+                # pass (poll + run_round), so outage windows must count
+                # down and fault draws advance exactly once per
+                # iteration — re-passing a stale index would re-roll the
+                # same round's draws every poll during an outage.
+                self.injector.begin_round(tick)
+            tick += 1
+            pods = self.api.poll_pod_batch(pod_batch_timeout_s)
             if not pods:
-                break  # control plane closed
-            self.run_once(pods)
+                if self.api.is_closed():
+                    break  # control plane closed: clean shutdown
+                # Transient outage / quiet channel: sweep-only idle
+                # round — unless a NOOP round or an eviction left
+                # runnable backlog behind, in which case this quiet
+                # poll is the moment to re-solve it.
+                self.run_round([], solve=self.backlog_dirty)
+                continue
+            self.run_round(pods)
             rounds += 1
 
+    # -- service checkpoint (scheduler state + the id maps) ----------------
 
-def podgen(api: ClusterAPI, num_pods: int, net_bw: int = 0) -> None:
+    def save_checkpoint(self, path: str) -> None:
+        """Snapshot the service: the scheduler's world state (via
+        runtime/checkpoint.py, written to ``path + ".sched"``) plus the
+        service-owned id maps and round bookkeeping as a sidecar at
+        ``path`` — everything a restarted process needs to keep serving
+        the same pods against the same nodes."""
+        from .runtime.checkpoint import save_scheduler
+
+        save_scheduler(self.scheduler, path + ".sched")
+        state = {
+            "version": SERVICE_CHECKPOINT_VERSION,
+            "pod_to_task": dict(self.pod_to_task),
+            "node_to_machine": dict(self.node_to_machine),
+            "job_id": self.job_id,
+            "old_bindings": dict(self.old_bindings),
+            "max_tasks_per_pu": self.max_tasks_per_pu,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    @classmethod
+    def restore(
+        cls,
+        api: ClusterAPI,
+        path: str,
+        cost_model: CostModelType = CostModelType.TRIVIAL,
+        backend=None,
+        backend_name: str = "configured",
+        degrade: bool = True,
+        injector: Optional[FaultInjector] = None,
+        tracer: Optional[RoundTracer] = None,
+        round_deadline_s: float = 0.0,
+    ) -> "SchedulerService":
+        """Rebuild a service from save_checkpoint output: the scheduler
+        is replayed through the event API, then the id maps are
+        re-attached. Heartbeat history does not survive the restart —
+        machines are unmonitored until their next beat (the same
+        cold-rebuild property the reference has)."""
+        from .runtime.checkpoint import restore_scheduler
+
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        if state["version"] != SERVICE_CHECKPOINT_VERSION:
+            raise ValueError(f"unsupported service checkpoint version {state['version']}")
+        if degrade:
+            backend = build_degradation_ladder(
+                backend if backend is not None else ReferenceSolver(),
+                backend_name,
+                injector=injector,
+            )
+        parts = restore_scheduler(
+            path + ".sched",
+            cost_model_factory=MODEL_REGISTRY[cost_model],
+            backend=backend,
+        )
+        svc = cls(
+            api,
+            max_tasks_per_pu=state["max_tasks_per_pu"],
+            cost_model=cost_model,
+            degrade=False,
+            injector=injector,
+            tracer=tracer,
+            round_deadline_s=round_deadline_s,
+            _restored=parts,
+        )
+        svc.job_id = state["job_id"]
+        svc.old_bindings = dict(state["old_bindings"])
+        # The pre-kill backlog flag is not checkpointed: assume dirty so
+        # the first quiet poll re-solves anything a pre-kill NOOP round
+        # or eviction left runnable, instead of starving it.
+        svc.backlog_dirty = True
+        # only tasks that still exist ride along (completed pods whose
+        # descriptors were dropped must not resurrect map entries)
+        for pod_id, task_id in state["pod_to_task"].items():
+            if svc.task_map.find(task_id) is not None:
+                svc.pod_to_task[pod_id] = task_id
+                svc.task_to_pod[task_id] = pod_id
+        for node_id, mid in state["node_to_machine"].items():
+            if svc.resource_map.find(mid) is not None:
+                svc.node_to_machine[node_id] = mid
+                svc.machine_to_node[mid] = node_id
+        return svc
+
+
+def _podgen_transient(e: Exception) -> bool:
+    """Transient control-plane errors podgen retries: 5xx (rides in as
+    HTTPError) and transport failures — URLError, ConnectionError, and
+    TimeoutError are all OSError subclasses, so OSError is the whole
+    net. Everything else (auth errors, schema rejections) is fatal."""
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500
+    return isinstance(e, OSError)
+
+
+def podgen(
+    api: ClusterAPI,
+    num_pods: int,
+    net_bw: int = 0,
+    retry_budget: int = 4,
+    backoff: Optional[ExpBackoff] = None,
+) -> None:
     """Load generator (reference: cmd/podgen/podgen.go:34-74). Against
     an HTTP control plane, pods are created via the API server (as the
     reference's podgen does); against the synthetic one, enqueued
-    directly."""
+    directly.
+
+    One transient 500 must not take the whole control plane down:
+    transient create failures are retried with exponential backoff
+    under a budget; only a fatal error (4xx, or a spent budget) warns
+    and closes the API — which unblocks get_pod_batch, since the
+    remaining pods will never arrive."""
+    backoff = backoff or ExpBackoff(max_retries=retry_budget)
+    i = 0
     try:
-        for i in range(num_pods):
-            if hasattr(api, "create_pod"):
-                api.create_pod(f"pod_{i}", net_bw_request=net_bw)
-            else:
-                api.submit_pod(PodEvent(pod_id=f"pod_{i}", net_bw_request=net_bw))
+        while i < num_pods:
+            try:
+                if hasattr(api, "create_pod"):
+                    api.create_pod(f"pod_{i}", net_bw_request=net_bw)
+                else:
+                    api.submit_pod(PodEvent(pod_id=f"pod_{i}", net_bw_request=net_bw))
+            except Exception as e:  # noqa: BLE001 — classified below
+                delay = backoff.next_delay() if _podgen_transient(e) else None
+                if delay is None:
+                    raise
+                warnings.warn(
+                    f"podgen: transient create_pod failure ({e}); retrying",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                time.sleep(delay)
+                continue
+            backoff.reset()
+            i += 1
     except Exception as e:  # noqa: BLE001 — runs in a daemon thread
-        # Surface the failure and unblock get_pod_batch (which would
-        # otherwise wait forever for pods that will never arrive).
-        print(f"podgen failed: {e}", file=sys.stderr)
+        warnings.warn(
+            f"podgen failed fatally after {i}/{num_pods} pods: {e}; "
+            "closing the control plane",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         api.close()
 
 
@@ -252,6 +609,15 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--podgen", type=int, default=0, metavar="N",
                     help="generate N pods in-process (cmd/podgen equivalent)")
+    ap.add_argument("--round-deadline", type=float, default=0.0, metavar="S",
+                    help="per-round watchdog deadline in seconds (0 = off): "
+                    "a round running past it warns and is recorded as a miss")
+    ap.add_argument("--no-degrade", action="store_true",
+                    help="disable the solver degradation ladder (a solver "
+                    "failure then crashes the round, as the reference does)")
+    ap.add_argument("--machine-timeout", type=float, default=0.0, metavar="S",
+                    help="enable heartbeat-driven machine failure detection "
+                    "with this timeout (0 = off); sweeps run every round")
     ap.add_argument("--one-shot", action="store_true",
                     help="exit once the pod queue is drained")
     ap.add_argument(
@@ -279,7 +645,12 @@ def main(argv=None) -> int:
         max_tasks_per_pu=args.max_tasks_per_pu,
         cost_model=CostModelType[args.cost_model.upper()],
         backend=backend,
+        backend_name=args.backend,
+        degrade=not args.no_degrade,
+        round_deadline_s=args.round_deadline,
     )
+    if args.machine_timeout > 0:
+        svc.enable_heartbeats(machine_timeout_s=args.machine_timeout)
     n = svc.init_topology(
         fake_machines=args.num_machines if args.fake_machines else 0,
         node_batch_timeout_s=args.node_batch_timeout,

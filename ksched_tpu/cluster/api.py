@@ -53,6 +53,32 @@ class ClusterAPI(abc.ABC):
         until ``timeout_s`` elapses with no new pod (reference:
         client.go:153-193). Returns [] only on close/shutdown."""
 
+    def poll_pod_batch(self, timeout_s: float) -> List[PodEvent]:
+        """Bounded variant of get_pod_batch: the *first* wait is capped
+        at ``timeout_s`` too, so an empty return can mean "no pods right
+        now" — not only "closed". The hardened service loop uses this
+        plus ``is_closed()`` to tell a transient API-server outage from
+        shutdown (an outage must idle the scheduler, never exit it) and
+        to keep heartbeat sweeps running while the queue is quiet.
+
+        Default: delegate to the blocking contract, under which an
+        empty batch *does* mean closed — recorded so the default
+        ``is_closed()`` agrees and the service loop still exits cleanly
+        for adapters that override neither method (overriding only one
+        of the pair would otherwise leave the loop spinning on instant
+        empty batches forever after close)."""
+        batch = self.get_pod_batch(timeout_s)
+        if not batch:
+            self._default_poll_closed = True
+        return batch
+
+    def is_closed(self) -> bool:
+        """True once close() has been called (or the transport knows the
+        control plane is gone for good). The loop-exit signal: an empty
+        batch alone is NOT one. Adapters with a real channel override
+        this; the default pairs with the default poll_pod_batch above."""
+        return getattr(self, "_default_poll_closed", False)
+
     @abc.abstractmethod
     def get_node_batch(self, timeout_s: float) -> List[NodeEvent]:
         """Same debounce contract for node arrivals (the reference polls
@@ -65,3 +91,12 @@ class ClusterAPI(abc.ABC):
 
     def close(self) -> None:
         """Stop delivering events; get_*_batch return [] afterwards."""
+
+
+#: The ``stats()`` keys that count retry/re-post attempts — the only
+#: keys the round trace folds into ``RoundRecord.retries``. Drop
+#: counters (binding_drops) are a separate signal and must stay out.
+#: An adapter defining a new retry counter must list it here to be
+#: attributed; an explicit list fails visibly where a substring match
+#: would drift silently.
+RETRY_STAT_KEYS = ("binding_retries", "watch_retries", "binding_reposts_pending")

@@ -9,6 +9,17 @@ reference against a bare kube-apiserver with no kubelets
 Side-door endpoints (prefixed /_test) play podgen and the node
 lifecycle: POST /_test/pods {"count": N}, POST /_test/nodes {...},
 GET /_test/bindings.
+
+Hermetic fault hooks: `fault_hook` (constructor arg or
+`set_fault_hook`) is consulted once per API request with a route kind
+("list_pods" | "list_nodes" | "bind" | "create_pod"; /_test side-door
+routes are never faulted) and may return
+``{"kind": "error", "code": 503}`` (respond with that status),
+``{"kind": "latency", "seconds": s}`` (sleep, then serve normally), or
+``{"kind": "hang", "seconds": s}`` (sleep, then drop the connection
+with no response — the client sees a timeout/connection error). A
+`runtime.chaos.FaultInjector.http_fault` plugs in directly, giving
+seeded 5xx/hang/latency schedules over real sockets.
 """
 
 from __future__ import annotations
@@ -18,9 +29,10 @@ import ssl
 import subprocess
 import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 #: process-wide cert cache: one keygen (+ one auto-cleaned temp dir)
@@ -66,6 +78,9 @@ class _State:
         self.pods: Dict[str, dict] = {}  # name -> spec
         self.nodes: List[dict] = []
         self.bindings: Dict[str, str] = {}  # pod -> node
+        #: (route_kind) -> None | {"kind": "error"|"hang"|"latency", ...};
+        #: mutable at runtime so tests flip faults on and off mid-flight
+        self.fault_hook: Optional[Callable[[str], Optional[dict]]] = None
 
     # shared by the HTTP handlers and the Python side-door so the two
     # entry points cannot drift on object schema
@@ -113,11 +128,38 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(401, {"error": "unauthorized"})
         return False
 
+    def _faulted(self, route: str) -> bool:
+        """Consult the fault hook; True = the request was consumed by an
+        injected fault and no normal handling should run."""
+        hook = self.state.fault_hook
+        if hook is None:
+            return False
+        action = hook(route)
+        if action is None:
+            return False
+        kind = action.get("kind")
+        if kind == "error":
+            self._json(int(action.get("code", 503)), {"error": "chaos: injected fault"})
+            return True
+        if kind == "hang":
+            # stall, then drop the connection without a response: the
+            # client experiences a hung request ending in a transport
+            # error (its timeout must be the bound, not our sleep)
+            time.sleep(float(action.get("seconds", 1.0)))
+            self.close_connection = True
+            return True
+        if kind == "latency":
+            time.sleep(float(action.get("seconds", 0.05)))
+            return False  # spike absorbed; serve normally
+        raise ValueError(f"unknown fault action {action!r}")
+
     def do_GET(self) -> None:
         if not self._authorized():
             return
         st = self.state
         if self.path.startswith("/api/v1/pods"):
+            if self._faulted("list_pods"):
+                return
             with st.lock:
                 # field-selector semantics: only pods not yet bound
                 items = [
@@ -127,6 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
                 ]
             self._json(200, {"kind": "PodList", "items": items})
         elif self.path.startswith("/api/v1/nodes"):
+            if self._faulted("list_nodes"):
+                return
             with st.lock:
                 items = list(st.nodes)
             self._json(200, {"kind": "NodeList", "items": items})
@@ -148,6 +192,8 @@ class _Handler(BaseHTTPRequestHandler):
             and parts[4] == "pods"
             and parts[6] == "binding"
         ):
+            if self._faulted("bind"):
+                return
             body = self._read_body()
             pod = parts[5]
             node = body.get("target", {}).get("name", "")
@@ -163,6 +209,8 @@ class _Handler(BaseHTTPRequestHandler):
             and parts[:3] == ["api", "v1", "namespaces"]
             and parts[4] == "pods"
         ):
+            if self._faulted("create_pod"):
+                return
             body = self._read_body()
             name = body.get("metadata", {}).get("name")
             if not name:
@@ -195,8 +243,14 @@ class FakeAPIServer:
     token auth (the reference's client is built with credentials,
     k8s/k8sclient/client.go:34-42)."""
 
-    def __init__(self, tls: bool = False, bearer: Optional[str] = None) -> None:
+    def __init__(
+        self,
+        tls: bool = False,
+        bearer: Optional[str] = None,
+        fault_hook: Optional[Callable[[str], Optional[dict]]] = None,
+    ) -> None:
         self._state = _State()
+        self._state.fault_hook = fault_hook
         handler = type(
             "Handler", (_Handler,), {"state": self._state, "bearer": bearer}
         )
@@ -257,6 +311,13 @@ class FakeAPIServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=2)
+
+    def set_fault_hook(
+        self, hook: Optional[Callable[[str], Optional[dict]]]
+    ) -> None:
+        """Install (or clear, with None) the per-request fault hook —
+        e.g. a FaultInjector's ``http_fault`` — at runtime."""
+        self._state.fault_hook = hook
 
     # -- convenience for tests/demos (the podgen/node side-door) -----------
 

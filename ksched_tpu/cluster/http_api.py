@@ -26,12 +26,15 @@ hermetically against the fake server's TLS mode.
 from __future__ import annotations
 
 import json
+import random
 import ssl
 import threading
 import urllib.error
 import urllib.request
-from typing import List, Optional, Set
+from collections import Counter
+from typing import Dict, List, Optional, Set
 
+from ..utils.backoff import ExpBackoff
 from .api import Binding, ClusterAPI, NodeEvent, PodEvent
 from .synthetic_api import SyntheticClusterAPI
 
@@ -47,6 +50,11 @@ class HTTPClusterAPI(ClusterAPI):
         ca_cert: Optional[str] = None,
         client_cert: Optional[str] = None,
         client_key: Optional[str] = None,
+        request_timeout_s: float = 5.0,
+        retry_budget: int = 4,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        backoff_rng: Optional[random.Random] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.namespace = namespace
@@ -66,6 +74,23 @@ class HTTPClusterAPI(ClusterAPI):
             raise ValueError(
                 "ca_cert/client_cert/client_key require an https base_url"
             )
+        self.request_timeout_s = request_timeout_s
+        self.retry_budget = retry_budget
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._backoff_rng = backoff_rng if backoff_rng is not None else random.Random()
+        # The watch loops' failure-streak backoff shares the ExpBackoff
+        # growth/jitter policy with the budgeted POST retries; base is
+        # the healthy cadence, and the cap never drops below it (a down
+        # control plane must not be probed faster than a healthy one).
+        self._watch_backoff = ExpBackoff(
+            base_s=max(poll_interval_s, 1e-6),
+            max_s=max(backoff_max_s, poll_interval_s),
+            rng=self._backoff_rng,
+        )
+        #: retry/drop observability: binding_retries / binding_drops /
+        #: watch_retries (lock-guarded; see stats())
+        self._counters: Counter = Counter()
         # The channel+debounce layer is shared with the synthetic
         # control plane; this adapter only adds the HTTP watch/post.
         self._chan = SyntheticClusterAPI(pod_chan_size=pod_chan_size)
@@ -83,10 +108,55 @@ class HTTPClusterAPI(ClusterAPI):
 
     # -- HTTP plumbing -----------------------------------------------------
 
-    def _open(self, req_or_url, timeout: float = 5):
+    def _open(self, req_or_url, timeout: Optional[float] = None):
         return urllib.request.urlopen(
-            req_or_url, timeout=timeout, context=self._ssl_ctx
+            req_or_url,
+            timeout=self.request_timeout_s if timeout is None else timeout,
+            context=self._ssl_ctx,
         )
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._bindings_lock:
+            self._counters[key] += n
+
+    def stats(self) -> Dict[str, int]:
+        """Retry/drop counters (binding_retries, binding_drops,
+        watch_retries) — the observability surface the round trace
+        folds into RoundRecord.retries."""
+        with self._bindings_lock:
+            return dict(self._counters)
+
+    def _backoff(self) -> ExpBackoff:
+        return ExpBackoff(
+            base_s=self.backoff_base_s,
+            max_s=self.backoff_max_s,
+            max_retries=self.retry_budget,
+            rng=self._backoff_rng,
+        )
+
+    def _post_with_retry(self, req, retry_counter: str) -> None:
+        """POST with exponential backoff + jitter under a retry budget.
+        5xx and transport errors are transient (retried); 4xx are
+        config/state errors and re-raise immediately. Raises the last
+        error once the budget is spent."""
+        backoff = self._backoff()
+        while True:
+            try:
+                with self._open(req) as r:
+                    r.read()
+                return
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    raise
+                err: Exception = e
+            except (urllib.error.URLError, OSError) as e:
+                err = e
+            delay = backoff.next_delay()
+            if delay is None:
+                raise err
+            self._count(retry_counter)
+            if self._stop.wait(delay):
+                raise err  # shutting down: stop retrying
 
     def _get_json(self, path: str) -> Optional[dict]:
         try:
@@ -98,13 +168,31 @@ class HTTPClusterAPI(ClusterAPI):
         except (urllib.error.URLError, OSError, json.JSONDecodeError):
             return None  # transient outage: informers keep retrying
 
+    def _watch_wait(self, failure_streak: int) -> float:
+        """Poll cadence with failure backoff: the normal interval while
+        the server answers; exponentially longer (capped, jittered)
+        across consecutive failures so a down control plane is probed,
+        not hammered."""
+        if failure_streak <= 0:
+            return self.poll_interval_s
+        # floor AFTER jitter: a downward draw must not probe a down
+        # control plane faster than the healthy cadence
+        return max(
+            self.poll_interval_s,
+            self._watch_backoff.delay_for(min(failure_streak, 8)),
+        )
+
     # -- watch loops (informer analogue) -----------------------------------
 
     def _watch_pods(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        failure_streak = 0
+        while not self._stop.wait(self._watch_wait(failure_streak)):
             got = self._get_json("/api/v1/pods?fieldSelector=spec.nodeName%3D%3D")
             if got is None:
+                failure_streak += 1
+                self._count("watch_retries")
                 continue
+            failure_streak = 0
             items = got.get("items", [])
             listed = {item["metadata"]["name"] for item in items}
             with self._bindings_lock:
@@ -136,10 +224,14 @@ class HTTPClusterAPI(ClusterAPI):
                         break
 
     def _watch_nodes(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        failure_streak = 0
+        while not self._stop.wait(self._watch_wait(failure_streak)):
             got = self._get_json("/api/v1/nodes")
-            if not got:
+            if got is None:  # transport failure — an empty listing is a healthy answer
+                failure_streak += 1
+                self._count("watch_retries")
                 continue
+            failure_streak = 0
             for item in got.get("items", []):
                 if item.get("spec", {}).get("unschedulable"):
                     continue  # reference skips unschedulable nodes (:91-95)
@@ -162,13 +254,23 @@ class HTTPClusterAPI(ClusterAPI):
     def get_pod_batch(self, timeout_s: float) -> List[PodEvent]:
         return self._chan.get_pod_batch(timeout_s)
 
+    def poll_pod_batch(self, timeout_s: float) -> List[PodEvent]:
+        return self._chan.poll_pod_batch(timeout_s)
+
+    def is_closed(self) -> bool:
+        return self._stop.is_set()
+
     def get_node_batch(self, timeout_s: float) -> List[NodeEvent]:
         return self._chan.get_node_batch(timeout_s)
 
     def create_pod(self, pod_id: str, **spec) -> None:
         """Create a pod via the control plane (the podgen path: the
         reference's load generator POSTs pods to the API server,
-        cmd/podgen/podgen.go:34-74)."""
+        cmd/podgen/podgen.go:34-74). Posts exactly once; retry policy
+        belongs to the caller — podgen already retries transient
+        failures with backoff under its own budget, and an adapter-level
+        retry layer underneath it would multiply worst-case attempts
+        (budget × budget) and stack two backoff schedules."""
         body = json.dumps(
             {"apiVersion": "v1", "kind": "Pod",
              "metadata": {"name": pod_id}, "spec": spec}
@@ -179,7 +281,8 @@ class HTTPClusterAPI(ClusterAPI):
             headers={"Content-Type": "application/json", **self._auth_headers},
             method="POST",
         )
-        self._open(req).read()
+        with self._open(req) as r:
+            r.read()
 
     def bindings(self) -> dict:
         """Pod→node placements this adapter successfully posted."""
@@ -204,10 +307,13 @@ class HTTPClusterAPI(ClusterAPI):
                 method="POST",
             )
             try:
-                self._open(req).read()
+                self._post_with_retry(req, "binding_retries")
             except (urllib.error.URLError, OSError):
-                # The reference logs and moves on (client.go:141-146);
-                # the pod stays pending and re-enters a later batch.
+                # Retry budget spent (or a 4xx): the reference logs and
+                # moves on (client.go:141-146); the pod stays pending
+                # and re-enters a later batch, where the service's
+                # re-deliver machinery re-emits the binding.
+                self._count("binding_drops")
                 with self._bindings_lock:
                     self._seen_pods.discard(b.pod_id)
             else:

@@ -49,6 +49,9 @@ class SyntheticClusterAPI(ClusterAPI):
     def close(self) -> None:
         self._closed.set()
 
+    def is_closed(self) -> bool:
+        return self._closed.is_set()
+
     # -- consumer side (the scheduler main loop) --------------------------
 
     def _batch(self, q: "queue.Queue", timeout_s: float, wait_first: bool) -> list:
@@ -61,10 +64,14 @@ class SyntheticClusterAPI(ClusterAPI):
         first_deadline = None if wait_first else time.monotonic() + timeout_s
         # Phase 1 (poll so close() can land).
         while not self._closed.is_set():
-            if first_deadline is not None and time.monotonic() >= first_deadline:
-                return batch
+            wait = 0.05
+            if first_deadline is not None:
+                remaining = first_deadline - time.monotonic()
+                if remaining <= 0:
+                    return batch
+                wait = min(wait, remaining)
             try:
-                batch.append(q.get(timeout=0.05))
+                batch.append(q.get(timeout=wait))
                 break
             except queue.Empty:
                 continue
@@ -85,6 +92,11 @@ class SyntheticClusterAPI(ClusterAPI):
 
     def get_pod_batch(self, timeout_s: float) -> List[PodEvent]:
         return self._batch(self._pods, timeout_s, wait_first=True)
+
+    def poll_pod_batch(self, timeout_s: float) -> List[PodEvent]:
+        """Bounded-first-wait batch (see ClusterAPI.poll_pod_batch):
+        empty means "quiet", not "closed" — check is_closed()."""
+        return self._batch(self._pods, timeout_s, wait_first=False)
 
     def get_node_batch(self, timeout_s: float) -> List[NodeEvent]:
         return self._batch(self._nodes, timeout_s, wait_first=False)

@@ -1,11 +1,19 @@
 """Runtime auxiliary subsystems: failure detection, checkpoint/resume,
-round tracing.
+round tracing, fault injection (chaos), and solver degradation.
 
-The reference carries the *fields* for all three (heartbeats on
+The reference carries the *fields* for the first three (heartbeats on
 ResourceStatus/TaskDescriptor, ResourceState LOST, ad hoc round timing)
-but implements none of them (SURVEY §5). Here they are first-class.
+but implements none of them (SURVEY §5). Here they are first-class —
+and the chaos harness (chaos.py) plus the degradation ladder
+(degrade.py) make the failure paths deterministic to exercise.
 """
 
+from .chaos import (
+    ChaosBackendError,
+    ChaosClusterAPI,
+    ChaosPolicy,
+    FaultInjector,
+)
 from .checkpoint import (
     load_bulk_checkpoint,
     load_device_checkpoint,
@@ -14,12 +22,21 @@ from .checkpoint import (
     save_device_checkpoint,
     save_scheduler,
 )
-from .failure import HeartbeatMonitor
+from .degrade import DegradingSolver, LadderExhausted, build_degradation_ladder
+from .failure import HeartbeatMonitor, RoundWatchdog
 from .trace import RoundTracer
 
 __all__ = [
+    "ChaosBackendError",
+    "ChaosClusterAPI",
+    "ChaosPolicy",
+    "DegradingSolver",
+    "FaultInjector",
     "HeartbeatMonitor",
+    "LadderExhausted",
     "RoundTracer",
+    "RoundWatchdog",
+    "build_degradation_ladder",
     "load_bulk_checkpoint",
     "load_device_checkpoint",
     "restore_scheduler",

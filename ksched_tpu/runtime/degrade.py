@@ -1,0 +1,163 @@
+"""The solver degradation ladder.
+
+The reference's loop dies with its solver: any solver failure (real
+non-convergence, an overflow, a poisoned cost input) kills the round
+and the process. Production schedulers degrade instead (Firmament runs
+a fallback scheduler when the flow solver misbehaves): here the ladder
+tries the configured backend, then steps down through cheaper/safer
+rungs (scan-CSR JAX solver, the exact `cpu_ref` oracle), and only when
+*every* rung fails raises `LadderExhausted` — which the scheduler
+service catches and turns into a NOOP round that keeps the previous
+assignments instead of crashing.
+
+The ladder also hosts the chaos seam: a `FaultInjector` (see chaos.py)
+can schedule per-rung faults — forced non-convergence, a backend
+exception, NaN'd cost inputs — which exercise exactly the paths real
+faults take.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, List, Optional, Tuple
+
+from ..graph.device_export import FlowProblem
+from ..solver.base import FlowResult, FlowSolver
+from .chaos import ChaosBackendError, FaultInjector, poison_costs
+
+#: failures a rung may raise that the ladder absorbs: non-convergence /
+#: infeasibility (RuntimeError), scaled-cost or potential overflow
+#: (OverflowError et al.), rejected inputs (ValueError). Anything else
+#: (KeyboardInterrupt, MemoryError, bugs raising TypeError) propagates.
+DEGRADABLE_ERRORS = (RuntimeError, ValueError, ArithmeticError)
+
+
+class LadderExhausted(RuntimeError):
+    """Every rung of the degradation ladder failed this round."""
+
+    def __init__(self, failures: List[Tuple[str, BaseException]]) -> None:
+        self.failures = failures
+        detail = "; ".join(f"{name}: {err}" for name, err in failures)
+        super().__init__(f"all solver rungs failed: {detail}")
+
+
+class DegradingSolver(FlowSolver):
+    """A FlowSolver that tries rungs in order until one converges.
+
+    ``rungs`` is a list of (name, backend_or_factory); factories are
+    called lazily on first use so fallback backends (and their jax
+    imports/compilations) cost nothing until a fault actually occurs.
+    Synchronous on purpose: the ladder must observe the failure before
+    the round's deltas are decoded, so it exposes only ``solve`` and
+    the placement driver runs it inside the dispatch phase.
+    """
+
+    def __init__(
+        self,
+        rungs: List[Tuple[str, object]],
+        injector: Optional[FaultInjector] = None,
+    ) -> None:
+        if not rungs:
+            raise ValueError("degradation ladder needs at least one rung")
+        self._rungs: List[Tuple[str, object]] = list(rungs)
+        self.injector = injector
+        self.degradations_total = 0
+        self.last_degradations = 0
+        self.last_rung = -1
+        self.last_rung_name: Optional[str] = None
+
+    # -- rung access -------------------------------------------------------
+
+    def rung_names(self) -> List[str]:
+        return [name for name, _ in self._rungs]
+
+    def _backend(self, i: int) -> FlowSolver:
+        name, b = self._rungs[i]
+        if not isinstance(b, FlowSolver) and callable(b):
+            b = b()
+            if not isinstance(b, FlowSolver):
+                raise TypeError(f"rung {name!r} factory returned {type(b).__name__}")
+            self._rungs[i] = (name, b)
+        return b
+
+    @property
+    def primary(self) -> FlowSolver:
+        """The configured (first-rung) backend."""
+        return self._backend(0)
+
+    # -- FlowSolver --------------------------------------------------------
+
+    def solve(self, problem: FlowProblem) -> FlowResult:
+        self.last_degradations = 0
+        self.last_rung = -1
+        self.last_rung_name = None
+        failures: List[Tuple[str, BaseException]] = []
+        for i, (name, _) in enumerate(self._rungs):
+            p = problem
+            try:
+                fault = self.injector.solver_fault(i) if self.injector else None
+                if fault == "exception":
+                    raise ChaosBackendError(f"chaos: injected backend exception ({name})")
+                if fault == "nonconverge":
+                    raise RuntimeError(f"chaos: forced non-convergence ({name})")
+                if fault == "nan_cost":
+                    p = poison_costs(problem)
+                result = self._backend(i).solve(p)
+            except DEGRADABLE_ERRORS as e:
+                failures.append((name, e))
+                self.degradations_total += 1
+                self.last_degradations += 1
+                nxt = self._rungs[i + 1][0] if i + 1 < len(self._rungs) else None
+                warnings.warn(
+                    f"solver rung {name!r} failed ({e}); "
+                    + (f"degrading to {nxt!r}" if nxt else "ladder exhausted"),
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                continue
+            self.last_rung = i
+            self.last_rung_name = name
+            return result
+        raise LadderExhausted(failures)
+
+    def reset(self) -> None:
+        # only instantiated rungs carry warm state worth dropping
+        for _, b in self._rungs:
+            if isinstance(b, FlowSolver):
+                b.reset()
+
+    # -- trace plumbing ----------------------------------------------------
+
+    @property
+    def last_iterations(self) -> int:
+        """Solver effort of the rung that actually produced the round
+        (RoundTracer reads this through the placement driver)."""
+        if self.last_rung < 0:
+            return 0
+        b = self._rungs[self.last_rung][1]
+        return getattr(b, "last_iterations", 0) or getattr(b, "last_supersteps", 0)
+
+
+def build_degradation_ladder(
+    configured: FlowSolver,
+    configured_name: str = "configured",
+    injector: Optional[FaultInjector] = None,
+    make_backend: Optional[Callable[[str], FlowSolver]] = None,
+) -> DegradingSolver:
+    """configured backend → scan-CSR JAX solver → cpu_ref oracle.
+
+    Rungs already covered by the configured backend are skipped (a
+    configured "jax" does not get a second jax rung). Fallback rungs are
+    lazy factories: no jax import or compile until a degradation fires.
+    """
+    if make_backend is None:
+        from ..solver.select import make_backend as make_backend_default
+
+        make_backend = make_backend_default
+    rungs: List[Tuple[str, object]] = [(configured_name, configured)]
+    cls = type(configured).__name__
+    if cls not in ("JaxSolver",) and configured_name != "jax":
+        rungs.append(("jax", lambda: make_backend("jax")))
+    if cls not in ("ReferenceSolver",) and configured_name != "ref":
+        rungs.append(("cpu_ref", lambda: make_backend("ref")))
+    return DegradingSolver(rungs, injector=injector)

@@ -29,6 +29,17 @@ class RoundRecord:
     arcs_added: int = 0
     arcs_changed: int = 0
     arcs_removed: int = 0
+    # -- robustness observability (chaos harness / hardened loop): every
+    # injected fault, retry, degradation, and heartbeat expiry is
+    # attributable to the round it landed in --------------------------------
+    faults_injected: Dict[str, int] = field(default_factory=dict)
+    retries: int = 0  # control-plane retry/re-post attempts this round
+    degradations: int = 0  # solver rungs stepped down this round
+    solver_rung: int = 0  # ladder rung that produced the round; -1 = no solve (NOOP if noop_round, else an idle sweep)
+    noop_round: bool = False  # ladder exhausted: previous assignments kept
+    deadline_miss: bool = False  # round blew its watchdog deadline
+    machines_lost: int = 0  # heartbeat-expired machines this sweep
+    tasks_failed: int = 0  # heartbeat-expired tasks this sweep
 
 
 class RoundTracer:
@@ -38,11 +49,27 @@ class RoundTracer:
 
     # -- recording --------------------------------------------------------
 
-    def record_flow_round(self, scheduler, num_scheduled: int) -> RoundRecord:
-        """Capture a FlowScheduler round from its last_timing + stats."""
+    def record_flow_round(
+        self,
+        scheduler,
+        num_scheduled: int,
+        extra: Optional[Dict] = None,
+        solved: bool = True,
+    ) -> RoundRecord:
+        """Capture a FlowScheduler round from its last_timing + stats.
+        ``extra`` carries the robustness counters (faults_injected,
+        retries, degradations, …) the hardened service loop attributes
+        to this round; unknown keys are rejected so counter names
+        cannot silently drift from the RoundRecord schema.
+
+        ``solved=False`` marks an idle sweep (no graph rebuild/solve
+        ran): the scheduler's dimacs_stats and solver-work counters
+        still hold the *previous* solved round's values and must not be
+        re-reported, or trace aggregations would multi-count that round
+        once per quiet poll."""
         t = scheduler.last_timing
-        stats = scheduler.dimacs_stats
-        backend = getattr(scheduler.solver, "backend", None)
+        stats = scheduler.dimacs_stats if solved else None
+        backend = getattr(scheduler.solver, "backend", None) if solved else None
         rec = RoundRecord(
             round_index=len(self.records),
             wall_time=time.time(),
@@ -57,11 +84,15 @@ class RoundTracer:
             num_scheduled=num_scheduled,
             solver_work=getattr(backend, "last_iterations", 0)
             or getattr(backend, "last_supersteps", 0),
-            nodes_added=stats.nodes_added,
-            arcs_added=stats.arcs_added,
-            arcs_changed=stats.arcs_changed,
-            arcs_removed=stats.arcs_removed,
+            nodes_added=stats.nodes_added if stats else 0,
+            arcs_added=stats.arcs_added if stats else 0,
+            arcs_changed=stats.arcs_changed if stats else 0,
+            arcs_removed=stats.arcs_removed if stats else 0,
         )
+        for k, v in (extra or {}).items():
+            if not hasattr(rec, k):
+                raise ValueError(f"unknown RoundRecord field {k!r}")
+            setattr(rec, k, v)
         self._append(rec)
         return rec
 

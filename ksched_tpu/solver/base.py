@@ -41,6 +41,19 @@ class FlowResult:
         return self.flow + problem.flow_offset
 
 
+def check_finite_costs(problem: FlowProblem) -> None:
+    """Reject a poisoned cost model (NaN/inf costs) up front. Every
+    backend calls this before its int cast — a non-finite float would
+    otherwise wrap into garbage potentials and be "solved" silently
+    (the chaos harness's nan_cost fault exists to catch exactly that;
+    see runtime/chaos.poison_costs)."""
+    if problem.cost.dtype.kind == "f" and not np.isfinite(problem.cost).all():
+        raise ValueError(
+            "non-finite arc costs in flow problem (NaN/inf from the "
+            "cost model); refusing to solve"
+        )
+
+
 def lower_bound_cost(problem: FlowProblem) -> int:
     """Cost carried by the folded lower-bound flow; every backend adds
     this to its solved objective so objectives are comparable."""

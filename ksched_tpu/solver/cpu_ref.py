@@ -19,7 +19,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver, lower_bound_cost
+from .base import FlowResult, FlowSolver, check_finite_costs, lower_bound_cost
 
 _INF = float("inf")
 
@@ -30,6 +30,7 @@ class ReferenceSolver(FlowSolver):
         m = len(problem.src)
         src = problem.src
         dst = problem.dst
+        check_finite_costs(problem)
         cap = problem.cap.astype(np.int64)
         cost = problem.cost.astype(np.int64)
         excess = problem.excess.astype(np.int64).copy()

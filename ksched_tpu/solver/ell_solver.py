@@ -51,7 +51,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver, lower_bound_cost
+from .base import FlowResult, FlowSolver, check_finite_costs, lower_bound_cost
 
 _BIG = jnp.int32(1 << 30)
 _BIG_D = 1 << 28
@@ -416,6 +416,7 @@ class EllSolver(FlowSolver):
             if (problem.excess > 0).any():
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
             return (problem, None, None, None)
+        check_finite_costs(problem)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
         cap = problem.cap.astype(np.int32)

@@ -57,7 +57,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver, lower_bound_cost
+from .base import FlowResult, FlowSolver, check_finite_costs, lower_bound_cost
 
 _BIG = jnp.int32(1 << 30)
 _P_GUARD = 1 << 30  # potential magnitude beyond this risks int32 overflow
@@ -320,6 +320,7 @@ class JaxSolver(FlowSolver):
             if (problem.excess > 0).any():
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
             return (problem, None, None, None)
+        check_finite_costs(problem)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
         cap = problem.cap.astype(np.int32)

@@ -32,7 +32,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver, lower_bound_cost
+from .base import FlowResult, FlowSolver, check_finite_costs, lower_bound_cost
 from .jax_solver import CsrPlan, build_csr_plan
 
 
@@ -244,6 +244,7 @@ class MegaSolver(FlowSolver):
             if (problem.excess > 0).any():
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
             return (problem, None, None, None)
+        check_finite_costs(problem)
         vetted = self._fits_ok_for is problem
         self._fits_ok_for = None
         if not vetted and not self.fits(problem):

@@ -19,7 +19,7 @@ import ctypes
 import numpy as np
 
 from ..graph.device_export import FlowProblem
-from .base import FlowResult, FlowSolver, lower_bound_cost
+from .base import FlowResult, FlowSolver, check_finite_costs, lower_bound_cost
 
 _ALGORITHMS = {"ssp": 0, "cost_scaling": 1}
 
@@ -60,6 +60,7 @@ class NativeSolver(FlowSolver):
     def solve(self, problem: FlowProblem) -> FlowResult:
         n = int(problem.num_nodes)
         m = len(problem.src)
+        check_finite_costs(problem)
         src = np.ascontiguousarray(problem.src, dtype=np.int32)
         dst = np.ascontiguousarray(problem.dst, dtype=np.int32)
         cap = np.ascontiguousarray(problem.cap, dtype=np.int32)

@@ -1,3 +1,4 @@
+from .backoff import ExpBackoff
 from .ids import (
     IDGenerator,
     SlotAllocator,
@@ -14,6 +15,7 @@ from .maps import JobMap, ResourceMap, ResourceStatus, TaskMap
 from .platform import force_cpu_platform
 
 __all__ = [
+    "ExpBackoff",
     "IDGenerator",
     "SlotAllocator",
     "equiv_class_from_bytes",

@@ -26,7 +26,9 @@ class ResourceStatus:
     descriptor: ResourceDescriptor
     topology_node: Optional[ResourceTopologyNodeDescriptor] = None
     endpoint_uri: str = ""
-    last_heartbeat: int = 0
+    #: None = never heartbeated. A numeric sentinel (the reference's 0)
+    #: would swallow a genuine beat at t=0 under an injected clock.
+    last_heartbeat: Optional[float] = None
 
 
 class _TypedMap(Generic[V]):

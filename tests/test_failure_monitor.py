@@ -1,0 +1,174 @@
+"""HeartbeatMonitor edge cases (all sweeps on an injected clock — no
+wall-clock sleeps anywhere): a LOST machine that resumes heartbeating
+after deregistration, a task unbound mid-sweep by its machine's loss,
+and the RoundWatchdog deadline semantics."""
+
+import time
+
+import pytest
+
+from ksched_tpu.data import ResourceState, TaskState
+from ksched_tpu.drivers import add_job, build_cluster
+from ksched_tpu.runtime import HeartbeatMonitor, RoundWatchdog
+
+
+def _machine_rids(rmap):
+    return [rid for rid, rs in rmap.items() if rs.descriptor.type.name == "MACHINE"]
+
+
+def _frozen_clock():
+    # any sweep that forgets to pass `now` would read an absurd fixed
+    # epoch and trip the assertions below — wall clock never enters
+    return lambda: 1e12
+
+
+def test_lost_machine_resuming_heartbeat_is_stale_not_resurrected():
+    """A machine that goes LOST and is deregistered may well come back
+    and keep beating (a partitioned node rejoining). The beat must be
+    ignored — counted as stale — not raise, and must NOT resurrect the
+    pruned machine: re-admission goes through registration."""
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=2, pus_per_core=2, max_tasks_per_pu=1
+    )
+    add_job(sched, jmap, tmap, num_tasks=2)
+    sched.schedule_all_jobs()
+    mon = HeartbeatMonitor(sched, machine_timeout_s=10.0, clock=_frozen_clock())
+    machines = _machine_rids(rmap)
+    for m in machines:
+        assert mon.record_machine_heartbeat(m, now=100.0)
+    mon.record_machine_heartbeat(machines[1], now=150.0)
+    lost, _ = mon.check(now=150.0)
+    assert lost == [machines[0]]
+    assert rmap.find(machines[0]) is None  # deregistered and pruned
+
+    # the "dead" machine resumes beating: stale, ignored, not fatal
+    assert mon.record_machine_heartbeat(machines[0], now=151.0) is False
+    assert mon.stale_heartbeats == 1
+    assert rmap.find(machines[0]) is None  # still gone
+    lost2, _ = mon.check(now=152.0)
+    assert lost2 == []  # and no repeat loss either
+
+
+def test_task_heartbeat_for_retired_task_is_stale():
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=1, pus_per_core=1)
+    mon = HeartbeatMonitor(sched, clock=_frozen_clock())
+    assert mon.record_task_heartbeat(123456789, now=1.0) is False
+    assert mon.stale_heartbeats == 1
+
+
+def test_task_unbound_mid_sweep_by_machine_loss_not_double_failed():
+    """One sweep, two expiries: a machine goes LOST, and a task running
+    ON that machine has a stale heartbeat too. The machine's deregister
+    evicts the task (back to RUNNABLE) before the task pass runs — the
+    sweep must NOT also fail it (HandleTaskFailure on an unbound task
+    would assert). Meanwhile a genuinely silent task on a *surviving*
+    machine must still be failed."""
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=2, pus_per_core=1, max_tasks_per_pu=1
+    )
+    add_job(sched, jmap, tmap, num_tasks=2)
+    n, _ = sched.schedule_all_jobs()
+    assert n == 2
+    mon = HeartbeatMonitor(
+        sched, machine_timeout_s=10.0, task_timeout_s=5.0, clock=_frozen_clock()
+    )
+    machines = _machine_rids(rmap)
+    bindings = dict(sched.get_task_bindings())
+    # which task lives on machines[0]? walk its subtree's bindings
+    from ksched_tpu.utils import resource_id_from_string
+
+    def tasks_on_machine(mrid):
+        out = []
+        stack = [rmap.find(mrid).topology_node]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children)
+            rid = resource_id_from_string(node.resource_desc.uuid)
+            out.extend(sched.resource_bindings.get(rid, ()))
+        return out
+
+    doomed = tasks_on_machine(machines[0])
+    assert len(doomed) == 1
+    survivor_task = next(t for t in bindings if t not in doomed)
+
+    for m in machines:
+        mon.record_machine_heartbeat(m, now=100.0)
+    mon.record_machine_heartbeat(machines[1], now=150.0)  # m0 goes silent
+    # BOTH tasks last beat long ago — both look stale at t=150
+    mon.record_task_heartbeat(doomed[0], now=100.0)
+    mon.record_task_heartbeat(survivor_task, now=100.0)
+
+    lost, failed = mon.check(now=150.0)
+    assert lost == [machines[0]]
+    # the machine's task was unbound mid-sweep: evicted, NOT failed
+    assert failed == [survivor_task]
+    assert tmap.find(doomed[0]).state == TaskState.RUNNABLE
+    assert tmap.find(survivor_task).state == TaskState.FAILED
+    assert doomed[0] not in sched.get_task_bindings()
+
+
+def test_injected_clock_never_consults_wall_clock():
+    """Sweeps with explicit `now` must be wall-clock-free end to end:
+    a monitor whose fallback clock would blow every timeout detects
+    nothing when the injected timeline says all is well."""
+    sched, rmap, jmap, tmap, root = build_cluster(num_machines=2, pus_per_core=1)
+    add_job(sched, jmap, tmap, num_tasks=2)
+    sched.schedule_all_jobs()
+    mon = HeartbeatMonitor(
+        sched, machine_timeout_s=1.0, task_timeout_s=1.0, clock=_frozen_clock()
+    )
+    for m in _machine_rids(rmap):
+        mon.record_machine_heartbeat(m, now=5.0)
+    for t in sched.get_task_bindings():
+        mon.record_task_heartbeat(t, now=5.0)
+    lost, failed = mon.check(now=5.5)  # within timeouts on the injected line
+    assert lost == [] and failed == []
+    # and the same state read through the frozen wall clock WOULD expire
+    lost, failed = mon.check()
+    assert len(lost) == 2
+
+
+def test_heartbeat_at_time_zero_is_monitored():
+    """A beat recorded at now=0.0 — round 0 of any logical-time driver,
+    e.g. the chaos soak — must arm monitoring, not read as "never
+    heartbeated" through a falsy-zero sentinel. A machine and a task
+    that beat only at t=0 and then go silent must both expire."""
+    sched, rmap, jmap, tmap, root = build_cluster(
+        num_machines=2, pus_per_core=1, max_tasks_per_pu=1
+    )
+    add_job(sched, jmap, tmap, num_tasks=2)
+    sched.schedule_all_jobs()
+    mon = HeartbeatMonitor(
+        sched, machine_timeout_s=10.0, task_timeout_s=5.0, clock=_frozen_clock()
+    )
+    machines = _machine_rids(rmap)
+    for m in machines:
+        assert mon.record_machine_heartbeat(m, now=0.0)
+    mon.record_machine_heartbeat(machines[1], now=20.0)  # m0 silent since t=0
+    for t in sched.get_task_bindings():
+        assert mon.record_task_heartbeat(t, now=0.0)
+    lost, failed = mon.check(now=20.0)
+    assert lost == [machines[0]]  # beat at t=0 armed the timeout
+    # the surviving machine's task beat only at t=0 too: silent, failed
+    assert len(failed) == 1
+    assert tmap.find(failed[0]).state == TaskState.FAILED
+
+
+def test_round_watchdog_fires_and_counts():
+    wd = RoundWatchdog(deadline_s=0.02)
+    with pytest.warns(RuntimeWarning, match="deadline"):
+        with wd:
+            time.sleep(0.08)
+        assert wd.fired
+    assert wd.misses == 1
+    # a fast round resets `fired` and adds no miss
+    with wd:
+        pass
+    assert not wd.fired and wd.misses == 1
+
+
+def test_round_watchdog_disabled_never_fires():
+    wd = RoundWatchdog(deadline_s=0.0)
+    with wd:
+        time.sleep(0.01)
+    assert not wd.fired and wd.misses == 0

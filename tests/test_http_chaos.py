@@ -1,0 +1,231 @@
+"""Hermetic HTTP fault injection: the fake API server's fault hooks
+(5xx / hang / latency over real sockets) against the HTTP adapter's
+retry/backoff machinery, plus the hardened podgen (transient errors
+retried, fatal ones close the control plane)."""
+
+import threading
+
+import pytest
+
+from ksched_tpu.cli import SchedulerService, podgen
+from ksched_tpu.cluster import Binding, FakeAPIServer, HTTPClusterAPI
+from ksched_tpu.utils import ExpBackoff
+
+
+def _api(server, **kw):
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("request_timeout_s", 0.5)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_max_s", 0.05)
+    return HTTPClusterAPI(server.base_url, **kw)
+
+
+class _FaultNTimes:
+    """Fail the first N requests to a route kind, then heal."""
+
+    def __init__(self, route, action, n):
+        self.route, self.action, self.left = route, action, n
+        self.lock = threading.Lock()
+
+    def __call__(self, route):
+        with self.lock:
+            if route == self.route and self.left > 0:
+                self.left -= 1
+                return dict(self.action)
+        return None
+
+
+# -- backoff primitive -----------------------------------------------------
+
+
+def test_exp_backoff_schedule_budget_and_jitter():
+    import random
+
+    b = ExpBackoff(base_s=0.1, max_s=0.5, factor=2.0, jitter=0.0, max_retries=4)
+    assert [b.next_delay() for _ in range(5)] == [0.1, 0.2, 0.4, 0.5, None]
+    b.reset()
+    assert b.next_delay() == 0.1
+    j = ExpBackoff(base_s=0.1, jitter=0.5, max_retries=3, rng=random.Random(0))
+    delays = [j.next_delay() for _ in range(3)]
+    assert all(0.05 <= d <= 0.15 * (2 ** i) for i, d in enumerate(delays))
+    with pytest.raises(ValueError):
+        ExpBackoff(base_s=0.0)
+
+
+# -- binding POST retry/backoff -------------------------------------------
+
+
+def test_binding_post_retries_through_5xx_and_lands():
+    hook = _FaultNTimes("bind", {"kind": "error", "code": 503}, 2)
+    server = FakeAPIServer(fault_hook=hook).start()
+    api = _api(server)
+    try:
+        server.create_pods(1)
+        api.get_pod_batch(timeout_s=0.5)
+        api.assign_bindings([Binding("pod_0", "node_x")])
+        assert server.bindings() == {"pod_0": "node_x"}  # landed despite 2x 503
+        stats = api.stats()
+        assert stats["binding_retries"] == 2
+        assert stats.get("binding_drops", 0) == 0
+    finally:
+        api.close()
+        server.stop()
+
+
+def test_binding_post_budget_exhausted_drops_and_pod_resurfaces():
+    hook = _FaultNTimes("bind", {"kind": "error", "code": 503}, 99)
+    server = FakeAPIServer(fault_hook=hook).start()
+    api = _api(server, retry_budget=2)
+    try:
+        server.create_pods(1)
+        assert [p.pod_id for p in api.get_pod_batch(timeout_s=0.5)] == ["pod_0"]
+        api.assign_bindings([Binding("pod_0", "node_x")])
+        assert server.bindings() == {}
+        stats = api.stats()
+        assert stats["binding_retries"] == 2 and stats["binding_drops"] == 1
+        # the pod is pending server-side and re-enters a later batch
+        assert [p.pod_id for p in api.get_pod_batch(timeout_s=0.5)] == ["pod_0"]
+        server.set_fault_hook(None)  # control plane heals
+        api.assign_bindings([Binding("pod_0", "node_x")])
+        assert server.bindings() == {"pod_0": "node_x"}
+    finally:
+        api.close()
+        server.stop()
+
+
+def test_binding_post_4xx_is_not_retried():
+    server = FakeAPIServer().start()
+    api = _api(server)
+    try:
+        # pod never created: the server answers 404, a state error —
+        # retrying would be useless; it must drop immediately
+        api.assign_bindings([Binding("ghost", "node_x")])
+        stats = api.stats()
+        assert stats.get("binding_retries", 0) == 0
+        assert stats["binding_drops"] == 1
+    finally:
+        api.close()
+        server.stop()
+
+
+def test_hang_fault_bounded_by_client_timeout_then_retry_lands():
+    """A hung request (server stalls past the client timeout, then drops
+    the connection) must cost one retry, not wedge the adapter."""
+    hook = _FaultNTimes("bind", {"kind": "hang", "seconds": 0.8}, 1)
+    server = FakeAPIServer(fault_hook=hook).start()
+    api = _api(server, request_timeout_s=0.2)
+    try:
+        server.create_pods(1)
+        api.get_pod_batch(timeout_s=0.5)
+        api.assign_bindings([Binding("pod_0", "node_x")])
+        assert server.bindings() == {"pod_0": "node_x"}
+        assert api.stats()["binding_retries"] == 1
+    finally:
+        api.close()
+        server.stop()
+
+
+def test_latency_spike_absorbed_without_retry():
+    hook = _FaultNTimes("bind", {"kind": "latency", "seconds": 0.1}, 1)
+    server = FakeAPIServer(fault_hook=hook).start()
+    api = _api(server)
+    try:
+        server.create_pods(1)
+        api.get_pod_batch(timeout_s=0.5)
+        api.assign_bindings([Binding("pod_0", "node_x")])
+        assert server.bindings() == {"pod_0": "node_x"}
+        assert api.stats().get("binding_retries", 0) == 0
+    finally:
+        api.close()
+        server.stop()
+
+
+# -- watch loops ride an outage -------------------------------------------
+
+
+def test_watch_loop_rides_listing_outage_with_backoff():
+    hook = _FaultNTimes("list_pods", {"kind": "error", "code": 503}, 3)
+    server = FakeAPIServer(fault_hook=hook).start()
+    api = _api(server)
+    try:
+        server.create_pods(2)
+        pods = api.get_pod_batch(timeout_s=3.0)  # outage spans ~3 polls
+        assert sorted(p.pod_id for p in pods) == ["pod_0", "pod_1"]
+        assert api.stats()["watch_retries"] >= 3
+    finally:
+        api.close()
+        server.stop()
+
+
+# -- hardened podgen (satellite) ------------------------------------------
+
+
+def test_podgen_rides_transient_500s_without_closing(recwarn):
+    hook = _FaultNTimes("create_pod", {"kind": "error", "code": 503}, 2)
+    server = FakeAPIServer(fault_hook=hook).start()
+    # create_pod posts exactly once (podgen owns the retry layer);
+    # budget 0 also disables binding retries, irrelevant here
+    api = _api(server, retry_budget=0)
+    try:
+        podgen(api, 3, backoff=ExpBackoff(base_s=0.01, max_retries=4))
+        assert not api.is_closed()  # transient blips must NOT close it
+        assert server.pending_pods() == 3
+        assert any("transient" in str(w.message) for w in recwarn.list)
+    finally:
+        api.close()
+        server.stop()
+
+
+def test_podgen_fatal_error_warns_and_closes():
+    server = FakeAPIServer(bearer="sekret").start()
+    api = _api(server, retry_budget=0)  # no token: every create is a 401
+    try:
+        with pytest.warns(RuntimeWarning, match="failed fatally"):
+            podgen(api, 2, backoff=ExpBackoff(base_s=0.01, max_retries=2))
+        assert api.is_closed()  # fatal: close, unblocking get_pod_batch
+        assert api.get_pod_batch(timeout_s=0.2) == []
+    finally:
+        api.close()
+        server.stop()
+
+
+def test_podgen_budget_exhaustion_is_fatal():
+    hook = _FaultNTimes("create_pod", {"kind": "error", "code": 503}, 99)
+    server = FakeAPIServer(fault_hook=hook).start()
+    api = _api(server, retry_budget=0)
+    try:
+        with pytest.warns(RuntimeWarning, match="failed fatally"):
+            podgen(api, 2, backoff=ExpBackoff(base_s=0.005, max_retries=2))
+        assert api.is_closed()
+    finally:
+        api.close()
+        server.stop()
+
+
+# -- end to end under chaos ------------------------------------------------
+
+
+def test_service_end_to_end_with_flaky_bindings():
+    """Full service over HTTP with the first 4 binding POST attempts
+    503ing: all pods still land (inside the per-POST retry budget),
+    observably through the retry counters."""
+    hook = _FaultNTimes("bind", {"kind": "error", "code": 503}, 4)
+    server = FakeAPIServer(fault_hook=hook).start()
+    for i in range(2):
+        server.add_node(f"node_{i}", cores=1, pus_per_core=2)
+    api = _api(server)
+    try:
+        svc = SchedulerService(api, max_tasks_per_pu=1)
+        svc.init_topology(node_batch_timeout_s=0.4)
+        server.create_pods(4)
+        svc.run(pod_batch_timeout_s=0.3, max_rounds=1)
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(server.bindings()) < 4:
+            time.sleep(0.05)
+        assert len(server.bindings()) == 4
+        assert api.stats()["binding_retries"] >= 4
+    finally:
+        api.close()
+        server.stop()

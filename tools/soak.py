@@ -7,6 +7,7 @@ mix wanders, and accounting leaks across enable/disable cycles.
 
 Usage: python tools/soak.py [--rounds 4096] [--tasks 20000] [--cpu]
        python tools/soak.py --preempt --checkpoint-every 4
+       python tools/soak.py --chaos --rounds 512 --seed 0
 Exit code 0 = all checkpoints clean.
 
 --preempt runs the soak in stability-aware preemption mode (hybrid
@@ -16,6 +17,17 @@ save/load_device_checkpoint every N chunks MID-SOAK — the restored
 cluster must be bit-identical and the soak continues on it (restart
 under churn at scale, not the unit test's toy shape; SURVEY §5
 "device-side graph state reconstructible at any time").
+
+--chaos runs the OTHER soak: the event-path SchedulerService under a
+seeded fault schedule (runtime/chaos.py) — control-plane outages,
+dropped binding POSTs, machine heartbeat flaps, forced solver faults
+(non-convergence / backend exceptions / NaN'd costs) — with mid-soak
+kill-and-restore from a service checkpoint. It asserts, every chunk:
+zero scheduler crashes (any exception fails the soak), supply/binding/
+capacity invariants, and at the end that every injected fault is
+accounted for in the per-round RoundRecord counters. --verify-determinism
+runs the whole soak twice and requires bit-identical final placements
+and fault totals. `make chaos-smoke` is the short fixed-seed CI entry.
 """
 
 import argparse
@@ -29,11 +41,229 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def check_service_invariants(svc, where: str) -> None:
+    """The event-path soak's state invariants: supply conservation in
+    the flow graph, binding/table consistency, per-PU capacity, and no
+    binding onto a machine the resource map no longer holds."""
+    from ksched_tpu.data import TaskState
+
+    sched = svc.scheduler
+    assert sched.gm.sink_node.excess == -len(sched.gm.task_to_node), (
+        f"supply invariant broken {where}: sink excess "
+        f"{sched.gm.sink_node.excess} vs {len(sched.gm.task_to_node)} tasks"
+    )
+    per_pu: dict = {}
+    for tid, rid in sched.task_bindings.items():
+        rs = svc.resource_map.find(rid)
+        assert rs is not None, f"binding onto missing resource {rid} {where}"
+        td = svc.task_map.find(tid)
+        assert td is not None and td.state == TaskState.RUNNING, (
+            f"bound task {tid} not RUNNING {where}"
+        )
+        assert tid in svc.task_to_pod, f"bound task {tid} missing pod map {where}"
+        per_pu[rid] = per_pu.get(rid, 0) + 1
+    for rid, n in per_pu.items():
+        assert n <= svc.max_tasks_per_pu, (
+            f"PU {rid} over capacity ({n} > {svc.max_tasks_per_pu}) {where}"
+        )
+    for pod_id, tid in svc.pod_to_task.items():
+        assert svc.task_to_pod.get(tid) == pod_id, (
+            f"pod map asymmetry for {pod_id} {where}"
+        )
+
+
+def run_chaos_soak(args, log=print) -> dict:
+    """Drive the SchedulerService for args.rounds rounds under a seeded
+    fault schedule, single-threaded and in logical time (1 round = 1 s
+    of heartbeat clock) so the whole run is deterministic. Returns the
+    final placements and fault totals for cross-run comparison."""
+    from ksched_tpu.cli import SchedulerService
+    from ksched_tpu.cluster import NodeEvent, PodEvent, SyntheticClusterAPI
+    from ksched_tpu.runtime import (
+        ChaosClusterAPI,
+        ChaosPolicy,
+        FaultInjector,
+        RoundTracer,
+    )
+    from ksched_tpu.solver.select import make_backend
+    from ksched_tpu.utils import seed_rng
+
+    seed_rng(args.seed)  # task/job/machine ids come from the global RNG
+    policy = ChaosPolicy(
+        seed=args.seed,
+        api_outage_prob=0.04,
+        api_outage_rounds=(1, 3),
+        binding_drop_prob=0.08,
+        machine_flap_prob=0.008,
+        machine_flap_rounds=(2, 5),
+        solver_fault_prob=0.06,
+        solver_total_outage_prob=0.01,
+    )
+    injector = FaultInjector(policy)
+    api = ChaosClusterAPI(SyntheticClusterAPI(), injector)
+    tracer = RoundTracer()
+    hb_timeout_s = 2.5  # a 3-round flap kills a machine; 2-round flaps survive
+
+    def make_service():
+        return SchedulerService(
+            api,
+            max_tasks_per_pu=args.slots,
+            backend=make_backend(args.chaos_backend),
+            backend_name=args.chaos_backend,
+            injector=injector,
+            tracer=tracer,
+            round_deadline_s=30.0,
+        )
+
+    svc = make_service()
+    svc.enable_heartbeats(machine_timeout_s=hb_timeout_s, task_timeout_s=1e9)
+    svc.init_topology(fake_machines=args.machines, pus_per_core=2)
+
+    wrng = np.random.default_rng(np.random.SeedSequence([args.seed, 0xC0C0]))
+    pod_seq = 0
+    pending_rejoin: list = []  # (due_round, node_id)
+    cooldown = 16  # fault-free tail so dropped bindings settle
+    total_rounds = args.rounds + cooldown
+    restores = 0
+    t0 = time.perf_counter()
+
+    for r in range(total_rounds):
+        now = float(r)
+        if r == args.rounds:
+            injector.quiesce()
+        injector.begin_round(r)
+
+        # node rejoin: machines lost to heartbeat expiry come back
+        while pending_rejoin and pending_rejoin[0][0] <= r:
+            _, node_id = pending_rejoin.pop(0)
+            svc.add_node(NodeEvent(node_id=node_id, num_cores=1, pus_per_core=2))
+
+        # workload: seeded pod arrivals (bounded backlog) + completions
+        if r < args.rounds:
+            if len(svc.pod_to_task) < args.machines * args.slots * 2:
+                for _ in range(int(wrng.integers(0, 4))):
+                    api.submit_pod(PodEvent(pod_id=f"pod_{pod_seq}"))
+                    pod_seq += 1
+            if r % 2 == 1:
+                bound = sorted(
+                    p for p, t in svc.pod_to_task.items()
+                    if t in svc.scheduler.task_bindings
+                )
+                if bound:
+                    k = int(wrng.integers(1, min(5, len(bound)) + 1))
+                    for j in sorted(
+                        int(x) for x in wrng.choice(len(bound), k, replace=False)
+                    ):
+                        svc.complete_pod(bound[j])
+
+        # heartbeats: every machine beats unless the injector flaps it
+        nodes_before = dict(svc.node_to_machine)
+        for node_id, mid in sorted(nodes_before.items()):
+            if not injector.machine_silent(mid):
+                svc.monitor.record_machine_heartbeat(mid, now=now)
+
+        pods = api.poll_pod_batch(0.005)
+        svc.run_round(pods, now=now)
+
+        # machines the sweep expired rejoin (as fresh registrations) later
+        for node_id in sorted(set(nodes_before) - set(svc.node_to_machine)):
+            pending_rejoin.append((r + 5, node_id))
+
+        if (r + 1) % args.chunk == 0 or r == total_rounds - 1:
+            check_service_invariants(svc, f"at round {r + 1}")
+            rec = tracer.records[-1]
+            log(
+                f"round {r + 1:6d}: live_pods={len(svc.pod_to_task)} "
+                f"bound={len(svc.scheduler.task_bindings)} "
+                f"machines={len(svc.node_to_machine)} "
+                f"noop={svc.noop_rounds} restores={restores} "
+                f"faults={sum(injector.counters.values())}",
+                flush=True,
+            )
+
+        # mid-soak kill-and-restore: the service process "dies" and a new
+        # one resumes from the checkpoint, with cold solver state
+        if (
+            args.chaos_restore_every
+            and r < args.rounds
+            and (r + 1) % args.chaos_restore_every == 0
+        ):
+            with tempfile.TemporaryDirectory() as td:
+                ckpt = os.path.join(td, "svc.ckpt")
+                svc.save_checkpoint(ckpt)
+                before_bindings = dict(svc.scheduler.task_bindings)
+                before_pods = dict(svc.pod_to_task)
+                svc = SchedulerService.restore(
+                    api,
+                    ckpt,
+                    backend=make_backend(args.chaos_backend),
+                    backend_name=args.chaos_backend,
+                    injector=injector,
+                    tracer=tracer,
+                    round_deadline_s=30.0,
+                )
+            svc.enable_heartbeats(machine_timeout_s=hb_timeout_s, task_timeout_s=1e9)
+            assert dict(svc.scheduler.task_bindings) == before_bindings, (
+                f"checkpoint restore changed bindings at round {r + 1}"
+            )
+            assert dict(svc.pod_to_task) == before_pods, (
+                f"checkpoint restore changed pod maps at round {r + 1}"
+            )
+            check_service_invariants(svc, f"after restore at round {r + 1}")
+            restores += 1
+
+    # every injected fault must be attributed to some round's record
+    attributed: dict = {}
+    for rec in tracer.records:
+        for k, v in rec.faults_injected.items():
+            attributed[k] = attributed.get(k, 0) + v
+    assert attributed == dict(injector.counters), (
+        f"fault accounting mismatch: rounds say {attributed}, "
+        f"injector says {dict(injector.counters)}"
+    )
+    noops = sum(1 for rec in tracer.records if rec.noop_round)
+    degr = sum(rec.degradations for rec in tracer.records)
+    dt = time.perf_counter() - t0
+    placements = {
+        pod: api.bindings().get(pod)
+        for pod in sorted(svc.pod_to_task)
+        if svc.pod_to_task[pod] in svc.scheduler.task_bindings
+    }
+    log(
+        f"CHAOS SOAK OK: {total_rounds} rounds in {dt:.1f}s — "
+        f"faults={dict(sorted(injector.counters.items()))} "
+        f"degradations={degr} noop_rounds={noops} restores={restores} "
+        f"final_bound={len(placements)}"
+    )
+    return {
+        "placements": placements,
+        "all_bindings": dict(api.bindings()),
+        "fault_totals": dict(injector.counters),
+        "noop_rounds": noops,
+        "degradations": degr,
+        "rounds": len(tracer.records),
+        "restores": restores,
+    }
+
+
+def chaos_main(args) -> int:
+    got = run_chaos_soak(args)
+    if args.verify_determinism:
+        again = run_chaos_soak(args)
+        for key in ("placements", "all_bindings", "fault_totals"):
+            assert got[key] == again[key], (
+                f"seed {args.seed} not deterministic: {key} differs across runs"
+            )
+        print("DETERMINISM OK: identical placements and fault totals across two runs")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=4096)
     ap.add_argument("--tasks", type=int, default=20_000)
-    ap.add_argument("--machines", type=int, default=500)
+    ap.add_argument("--machines", type=int, default=None,
+                    help="default: 500 (device soak), 10 (chaos mode)")
     ap.add_argument("--chunk", type=int, default=256)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--preempt", action="store_true",
@@ -41,7 +271,28 @@ def main() -> int:
     ap.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
                     help="save+load+verify a device checkpoint every N "
                     "chunks and continue on the RESTORED cluster")
+    ap.add_argument("--chaos", action="store_true",
+                    help="event-path SchedulerService soak under a seeded "
+                    "fault schedule (see module docstring)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=16,
+                    help="chaos mode: task slots per PU")
+    ap.add_argument("--chaos-backend", default="jax",
+                    help="chaos mode: configured solver backend (first "
+                    "ladder rung)")
+    ap.add_argument("--chaos-restore-every", type=int, default=128, metavar="N",
+                    help="chaos mode: kill-and-restore from a service "
+                    "checkpoint every N rounds (0 = never)")
+    ap.add_argument("--verify-determinism", action="store_true",
+                    help="chaos mode: run twice, require identical "
+                    "placements + fault totals")
     args = ap.parse_args()
+    if args.machines is None:  # per-mode default (device soak vs chaos)
+        args.machines = 10 if args.chaos else 500
+
+    if args.chaos:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return chaos_main(args)
 
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
