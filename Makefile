@@ -5,7 +5,11 @@
 #   make chaos-smoke  short fixed-seed chaos soak (fault injection +
 #                     degradation ladder + restore + determinism check;
 #                     docs/robustness.md)
-#   make verify       lint, then tests, then the chaos smoke
+#   make obs-smoke    short chaos soak serving live /metricsz; scrapes
+#                     its own endpoint and asserts the served counters
+#                     reconcile exactly with the RoundRecord totals
+#                     (docs/observability.md)
+#   make verify       lint, then tests, then the chaos + obs smokes
 #   make baseline     re-accept current lint violations (ratchet; avoid —
 #                     fix or suppress inline instead, docs/static_analysis.md)
 
@@ -14,7 +18,7 @@ SHELL := /bin/bash
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test chaos-smoke verify baseline
+.PHONY: lint test chaos-smoke obs-smoke verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
@@ -23,6 +27,11 @@ chaos-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/soak.py --chaos \
 	  --rounds 96 --chunk 32 --seed 0 --machines 6 --slots 8 \
 	  --chaos-restore-every 48 --verify-determinism
+
+obs-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/soak.py --chaos \
+	  --rounds 64 --chunk 32 --seed 3 --machines 6 --slots 8 \
+	  --chaos-restore-every 0 --metrics-port 0
 
 test:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -33,7 +42,7 @@ test:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-verify: lint test chaos-smoke
+verify: lint test chaos-smoke obs-smoke
 
 baseline:
 	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

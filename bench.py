@@ -1612,6 +1612,16 @@ def main():
         "decode_width, task_capacity, n_windows); recorded in the "
         "output record",
     )
+    ap.add_argument(
+        "--obs-out", default=None, metavar="PATH",
+        help="write the obs metrics-registry snapshot JSON at exit "
+        "(ksched_tpu/obs; docs/observability.md)",
+    )
+    ap.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="record obs spans during the measured rounds and write a "
+        "Chrome/Perfetto trace-event JSON at exit",
+    )
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument("--fell-back", dest="fell_back_flag",
                     action="store_true", help=argparse.SUPPRESS)
@@ -1633,12 +1643,74 @@ def main():
     import jax
 
     if args.suite:
+        if args.trace_out or args.obs_out:
+            # each suite config runs in its own subprocess; a tracer or
+            # registry in this parent would capture nothing
+            ap.error(
+                "--trace-out/--obs-out apply to a single run, not "
+                "--suite (pass them to one config instead)"
+            )
         return run_suite(args)
-    if args.config:
-        return run_config(args)
-    if args.backend in ("auto", "device"):
-        args.backend = "device"
-        return run_device_bench(args)
+
+    span_tracer = None
+    if args.trace_out:
+        from ksched_tpu.obs import SpanTracer
+
+        span_tracer = SpanTracer().install()
+    try:
+        if args.config:
+            return run_config(args)
+        if args.backend in ("auto", "device"):
+            args.backend = "device"
+            return run_device_bench(args)
+        return _run_bulk_bench(args)
+    finally:
+        if span_tracer is not None:
+            span_tracer.uninstall()
+            span_tracer.dump(args.trace_out)
+            print(f"# obs: span trace -> {args.trace_out}", file=sys.stderr)
+            if span_tracer.total == 0:
+                print(
+                    "# obs: WARNING: no spans were recorded — spans cover "
+                    "the host bulk/layered round paths; the device-resident "
+                    "path runs fused inside jit and records none",
+                    file=sys.stderr,
+                )
+        if args.obs_out:
+            from ksched_tpu.obs import dump_registry, get_registry
+
+            reg = get_registry()
+            dump_registry(reg, args.obs_out)
+            print(f"# obs: registry snapshot -> {args.obs_out}", file=sys.stderr)
+            if not reg.collect():
+                print(
+                    "# obs: WARNING: the registry snapshot is empty — round "
+                    "metrics are published by the host bulk/layered bench "
+                    "paths (--cpu --backend native/ref/layered), not the "
+                    "device or --config paths",
+                    file=sys.stderr,
+                )
+
+
+def _publish_bench_obs(lat_ms, rounds_meta) -> None:
+    """Mirror the measured rounds onto the obs metrics registry AFTER
+    the clock stops, so --obs-out snapshots carry the same round/phase
+    series the service publishes live while the measured loop itself
+    performs zero registry operations — the overhead protocol in
+    BENCH_OBS_OVERHEAD_r09.json depends on that. Publication goes
+    through RoundTracer so the metric names, label sets, and the
+    timing-key → phase mapping stay single-sourced in runtime/trace.py."""
+    from ksched_tpu.runtime.trace import RoundTracer
+
+    tracer = RoundTracer(capacity=1)  # publication only; records unused
+    for total_ms, (timing, placed, work) in zip(lat_ms, rounds_meta):
+        tracer.record_timed_round(
+            timing, total_ms=total_ms, num_scheduled=placed, solver_work=work
+        )
+
+
+def _run_bulk_bench(args):
+    import jax
 
     rng = np.random.default_rng(0)
     cluster, backend = build(args)
@@ -1661,6 +1733,7 @@ def main():
     # Steady state: churn + measure.
     churn_n = max(1, int(args.tasks * args.churn))
     lat_ms = []
+    rounds_meta = []
     for i in range(args.rounds):
         placed_rows = np.nonzero(cluster.task_pu >= 0)[0]
         done = rng.choice(placed_rows, size=min(churn_n, len(placed_rows)), replace=False)
@@ -1669,6 +1742,7 @@ def main():
         cluster.add_tasks(churn_n, rng.integers(0, args.jobs, churn_n).astype(np.int32))
         r = cluster.round()
         lat_ms.append((time.perf_counter() - t0) * 1e3)
+        rounds_meta.append((r.timing, len(r.placed_tasks), _solver_work(backend)))
         if args.verbose:
             t = r.timing
             print(
@@ -1679,6 +1753,8 @@ def main():
                 file=sys.stderr,
             )
 
+    if args.obs_out:
+        _publish_bench_obs(lat_ms, rounds_meta)
     p50 = float(np.percentile(lat_ms, 50))
     target_ms = 10.0
     _emit_record(

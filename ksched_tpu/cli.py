@@ -24,6 +24,9 @@ from typing import Dict, List, Optional, Tuple
 from .cluster import Binding, ClusterAPI, NodeEvent, PodEvent, SyntheticClusterAPI
 from .cluster.api import RETRY_STAT_KEYS
 from .costmodels import MODEL_REGISTRY, CostModelType
+from .obs import metrics as obs_metrics
+from .obs.flight import FlightRecorder
+from .obs.spans import SpanTracer, span
 from .drivers.synthetic import (
     add_machine,
     add_task_to_job,
@@ -72,11 +75,20 @@ class SchedulerService:
         injector: Optional[FaultInjector] = None,
         tracer: Optional[RoundTracer] = None,
         round_deadline_s: float = 0.0,
+        flight: Optional[FlightRecorder] = None,
+        span_tracer: Optional[SpanTracer] = None,
         _restored: Optional[Tuple] = None,
     ) -> None:
         self.api = api
         self.injector = injector
         self.tracer = tracer
+        self.flight = flight
+        self.span_tracer = span_tracer
+        # service-level gauges (inert singletons when obs is disabled)
+        reg = obs_metrics.get_registry()
+        self._g_pods = reg.gauge("ksched_live_pods", "pods the service tracks")
+        self._g_bound = reg.gauge("ksched_bound_tasks", "tasks currently bound")
+        self._g_machines = reg.gauge("ksched_machines", "machines in the topology")
         self.watchdog = RoundWatchdog(round_deadline_s)
         self.monitor: Optional[HeartbeatMonitor] = None
         if _restored is None:
@@ -326,7 +338,26 @@ class SchedulerService:
         quiet polls while the backlog is clean, so a steady-state
         service costs a sweep per batch timeout, not a full MCMF
         solve. Recorded with ``solver_rung`` -1 and ``noop_round``
-        False (a NOOP is a *failed* solve; this is a skipped one)."""
+        False (a NOOP is a *failed* solve; this is a skipped one).
+
+        With a span tracer and flight recorder attached, the whole
+        round runs under a ``service_round`` span and the round's
+        record + span slice are deposited in the flight ring (which
+        auto-dumps on a deadline miss or NOOP round)."""
+        span_mark = self.span_tracer.mark() if self.span_tracer is not None else 0
+        rec = None
+        with span("service_round", pods=len(pods), solve=solve):
+            rec, bound = self._run_round_body(pods, now, solve)
+        if self.flight is not None and rec is not None:
+            events = (
+                self.span_tracer.events_since(span_mark)
+                if self.span_tracer is not None
+                else None
+            )
+            self.flight.note_round(rec, events)
+        return bound
+
+    def _run_round_body(self, pods, now, solve):
         deg_mark = self.ladder.degradations_total if self.ladder is not None else 0
         noop = False
         bound = 0
@@ -364,6 +395,10 @@ class SchedulerService:
             self.backlog_dirty = True
         elif solve:
             self.backlog_dirty = False
+        self._g_pods.set(len(self.pod_to_task))
+        self._g_bound.set(len(self.scheduler.task_bindings))
+        self._g_machines.set(len(self.node_to_machine))
+        rec = None
         if self.tracer is not None:
             faults = {}
             if self.injector is not None:
@@ -379,7 +414,7 @@ class SchedulerService:
                 for k in RETRY_STAT_KEYS
             )
             self._api_stats_mark = api_stats
-            self.tracer.record_flow_round(
+            rec = self.tracer.record_flow_round(
                 self.scheduler,
                 bound,
                 # idle sweeps must not re-report the previous solve's
@@ -404,7 +439,7 @@ class SchedulerService:
                     tasks_failed=len(failed),
                 ),
             )
-        return bound
+        return rec, bound
 
     def run(self, pod_batch_timeout_s: float = 2.0, max_rounds: Optional[int] = None) -> None:
         """The hardened main loop. Exits only when the control plane is
@@ -472,6 +507,8 @@ class SchedulerService:
         injector: Optional[FaultInjector] = None,
         tracer: Optional[RoundTracer] = None,
         round_deadline_s: float = 0.0,
+        flight: Optional[FlightRecorder] = None,
+        span_tracer: Optional[SpanTracer] = None,
     ) -> "SchedulerService":
         """Rebuild a service from save_checkpoint output: the scheduler
         is replayed through the event API, then the id maps are
@@ -503,6 +540,8 @@ class SchedulerService:
             injector=injector,
             tracer=tracer,
             round_deadline_s=round_deadline_s,
+            flight=flight,
+            span_tracer=span_tracer,
             _restored=parts,
         )
         svc.job_id = state["job_id"]
@@ -620,6 +659,29 @@ def main(argv=None) -> int:
                     "with this timeout (0 = off); sweeps run every round")
     ap.add_argument("--one-shot", action="store_true",
                     help="exit once the pod queue is drained")
+    # -- observability (ksched_tpu/obs; docs/observability.md) ----------
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve Prometheus text on /metricsz (+ /healthz, "
+                    "/varz) from this port (0 = ephemeral; off by default)")
+    ap.add_argument("--obs-dump", metavar="PATH", default=None,
+                    help="write the metrics-registry snapshot as JSON on exit")
+    ap.add_argument("--trace-out", metavar="PATH", default=None,
+                    help="record spans and write a Chrome/Perfetto "
+                    "trace-event JSON on exit")
+    ap.add_argument("--round-trace", metavar="PATH", default=None,
+                    help="write the per-round RoundRecord JSONL on exit")
+    ap.add_argument("--flight-dir", metavar="DIR", default=None,
+                    help="enable the crash flight recorder: ring of the "
+                    "last --flight-capacity rounds, auto-dumped into DIR "
+                    "on deadline miss / NOOP round / crash")
+    ap.add_argument("--flight-capacity", type=int, default=64)
+    ap.add_argument("--devprof-capture", type=int, default=0, metavar="N",
+                    help="capture a jax.profiler trace around the Nth "
+                    "solve (0 = off)")
+    ap.add_argument("--devprof-dir", metavar="DIR", default="./jax_profile")
+    ap.add_argument("--no-obs", action="store_true",
+                    help="disable the metrics registry entirely (null "
+                    "registry; spans still time RoundTiming)")
     ap.add_argument(
         "--api-server", metavar="URL", default=None,
         help="schedule against a control plane over HTTP (the reference's "
@@ -629,15 +691,69 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.one_shot and args.podgen <= 0:
         ap.error("--one-shot needs --podgen N: the pod wait blocks until a first pod arrives")
+    if args.no_obs and (args.metrics_port is not None or args.obs_dump):
+        ap.error(
+            "--no-obs disables the metrics registry; --metrics-port/--obs-dump "
+            "would serve/dump nothing (spans and --round-trace still work)"
+        )
+
+    # An operator SIGTERM must exit through main's finally so the
+    # dump-on-exit artifacts (--obs-dump/--trace-out/--round-trace)
+    # still land; default SIGTERM disposition would drop them.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(143))
 
     from .solver.select import make_backend
 
     backend = make_backend(args.backend)
 
+    # -- observability setup (before any instrumented object resolves
+    # its metric handles) ------------------------------------------------
+    if args.no_obs:
+        obs_metrics.set_enabled(False)
+    metrics_server = None
+    if args.metrics_port is not None:
+        from .obs.exporter import MetricsServer
+
+        metrics_server = MetricsServer(port=args.metrics_port)
+        print(f"metrics: {metrics_server.url}/metricsz", file=sys.stderr)
+    # the flight recorder needs a tracer too: its dumps carry each
+    # round's span slice (and double as Perfetto traces)
+    span_tracer = (
+        SpanTracer().install() if (args.trace_out or args.flight_dir) else None
+    )
+    # flight-only services need records but not the whole history:
+    # bound the tracer at the ring size so a weeks-long run does not
+    # accumulate records nothing will ever dump
+    tracer = None
+    if args.round_trace:
+        tracer = RoundTracer()
+    elif args.flight_dir:
+        tracer = RoundTracer(capacity=args.flight_capacity)
+    flight = None
+    if args.flight_dir:
+        flight = FlightRecorder(
+            capacity=args.flight_capacity, dump_dir=args.flight_dir
+        )
+        flight.install_crash_hook()
+    if args.devprof_capture > 0:
+        from .obs.devprof import DeviceProfiler, set_profiler
+
+        set_profiler(
+            DeviceProfiler(
+                capture_solve=args.devprof_capture, capture_dir=args.devprof_dir
+            )
+        )
+
     if args.api_server:
         from .cluster.http_api import HTTPClusterAPI
 
-        api = HTTPClusterAPI(args.api_server, pod_chan_size=args.pod_chan_size)
+        api = HTTPClusterAPI(
+            args.api_server,
+            pod_chan_size=args.pod_chan_size,
+            registry=obs_metrics.get_registry(),
+        )
     else:
         api = SyntheticClusterAPI(pod_chan_size=args.pod_chan_size)
     svc = SchedulerService(
@@ -648,6 +764,9 @@ def main(argv=None) -> int:
         backend_name=args.backend,
         degrade=not args.no_degrade,
         round_deadline_s=args.round_deadline,
+        tracer=tracer,
+        flight=flight,
+        span_tracer=span_tracer,
     )
     if args.machine_timeout > 0:
         svc.enable_heartbeats(machine_timeout_s=args.machine_timeout)
@@ -665,7 +784,11 @@ def main(argv=None) -> int:
     try:
         if args.one_shot:
             pods = api.get_pod_batch(args.pod_batch_timeout)
-            bound = svc.run_once(pods) if pods else 0
+            # run_round, not run_once: the hardened round is also the
+            # obs publication path (RoundRecord -> tracer/registry,
+            # flight ring, service gauges) — one-shot must not produce
+            # empty --round-trace/--flight-dir artifacts
+            bound = svc.run_round(pods) if pods else 0
             lat = svc.round_latencies_s[-1] * 1e3 if svc.round_latencies_s else 0.0
             print(
                 f"scheduled {bound}/{len(pods)} pods in {lat:.2f}ms "
@@ -677,6 +800,22 @@ def main(argv=None) -> int:
         return 0
     finally:
         api.close()
+        # dump-on-exit artifacts (after close so final counters settle)
+        if args.obs_dump:
+            from .obs.exporter import dump_registry
+
+            dump_registry(obs_metrics.get_registry(), args.obs_dump)
+            print(f"obs: registry snapshot -> {args.obs_dump}", file=sys.stderr)
+        if span_tracer is not None:
+            span_tracer.uninstall()
+            if args.trace_out:
+                span_tracer.dump(args.trace_out)
+                print(f"obs: span trace -> {args.trace_out}", file=sys.stderr)
+        if args.round_trace and tracer is not None:
+            tracer.dump(args.round_trace)
+            print(f"obs: round trace -> {args.round_trace}", file=sys.stderr)
+        if metrics_server is not None:
+            metrics_server.stop()
 
 
 if __name__ == "__main__":

@@ -31,9 +31,9 @@ import ssl
 import threading
 import urllib.error
 import urllib.request
-from collections import Counter
 from typing import Dict, List, Optional, Set
 
+from ..obs.metrics import Registry
 from ..utils.backoff import ExpBackoff
 from .api import Binding, ClusterAPI, NodeEvent, PodEvent
 from .synthetic_api import SyntheticClusterAPI
@@ -55,6 +55,7 @@ class HTTPClusterAPI(ClusterAPI):
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         backoff_rng: Optional[random.Random] = None,
+        registry: Optional[Registry] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.namespace = namespace
@@ -88,9 +89,26 @@ class HTTPClusterAPI(ClusterAPI):
             max_s=max(backoff_max_s, poll_interval_s),
             rng=self._backoff_rng,
         )
-        #: retry/drop observability: binding_retries / binding_drops /
-        #: watch_retries (lock-guarded; see stats())
-        self._counters: Counter = Counter()
+        # Retry/drop observability (binding_retries / binding_drops /
+        # watch_retries): counters live on an obs metrics registry —
+        # every labeled child carries its own lock, so the two watch
+        # threads and the scheduler thread publish without a shared
+        # read-modify-write (tests/test_obs.py hammers this). The
+        # default is a PRIVATE registry: stats() must be per-adapter
+        # exact, and two adapters on a shared registry would alias the
+        # same counter family. The service passes the process registry
+        # explicitly (one adapter per process) so the counters also
+        # serve on /metricsz; with obs disabled that falls back to a
+        # private real Registry so stats() stays correct.
+        reg = registry if registry is not None else Registry()
+        if not isinstance(reg, Registry):  # e.g. handed the NullRegistry
+            reg = Registry()
+        self._events = reg.counter(
+            "ksched_http_api_events_total",
+            "control-plane adapter events (binding_retries, binding_drops, "
+            "watch_retries)",
+            labelnames=("event",),
+        )
         # The channel+debounce layer is shared with the synthetic
         # control plane; this adapter only adds the HTTP watch/post.
         self._chan = SyntheticClusterAPI(pod_chan_size=pod_chan_size)
@@ -116,15 +134,16 @@ class HTTPClusterAPI(ClusterAPI):
         )
 
     def _count(self, key: str, n: int = 1) -> None:
-        with self._bindings_lock:
-            self._counters[key] += n
+        self._events.labels(event=key).inc(n)
 
     def stats(self) -> Dict[str, int]:
         """Retry/drop counters (binding_retries, binding_drops,
         watch_retries) — the observability surface the round trace
         folds into RoundRecord.retries."""
-        with self._bindings_lock:
-            return dict(self._counters)
+        return {
+            labels["event"]: int(child.value)
+            for labels, child in self._events.samples()
+        }
 
     def _backoff(self) -> ExpBackoff:
         return ExpBackoff(

@@ -1,0 +1,74 @@
+"""ksched_tpu.obs: the observability subsystem.
+
+Four pieces, threaded through every layer of the scheduling loop:
+
+- **metrics** — a process-wide registry of Counters, Gauges, and
+  log-bucketed Histograms with labels, cheap enough for per-round
+  hot-path use and thread-safe for the HTTP adapter's watch threads;
+- **spans** — contextvar-based hierarchical span tracing whose output
+  is Chrome/Perfetto trace-event JSON; `RoundTiming` (and therefore
+  the RoundRecord JSONL) is *derived from* these spans, so the trace
+  artifact and the live metrics can never disagree;
+- **exporter** — Prometheus text-format exposition from a stdlib HTTP
+  thread (`/metricsz`, `/healthz`, `/varz`) plus dump-on-exit;
+- **devprof** — device-side accounting (per-solve superstep/rung
+  counters, host→device bytes per round, opt-in `jax.profiler`
+  capture around the Nth solve);
+- **flight** — a crash flight recorder: the last N rounds' records and
+  spans, auto-dumped on deadline miss, ladder exhaustion, or crash.
+
+`KSCHED_OBS=0` (or `metrics.set_enabled(False)`) switches the global
+registry to an inert null registry; span timing still feeds
+RoundTiming (it costs what the hand-rolled timers it replaced cost)
+but nothing records unless a SpanTracer is installed.
+"""
+
+from .devprof import DeviceProfiler, get_profiler, set_profiler
+from .exporter import (
+    MetricsServer,
+    dump_registry,
+    parse_prometheus,
+    render_prometheus,
+    scrape,
+)
+from .flight import FlightRecorder
+from .metrics import (
+    DEFAULT_MS_BUCKETS,
+    NULL_METRIC,
+    NULL_REGISTRY,
+    Registry,
+    enabled,
+    get_registry,
+    log_buckets,
+    scoped_registry,
+    set_enabled,
+    set_registry,
+)
+from .spans import Span, SpanTracer, active_tracer, span, start_span
+
+__all__ = [
+    "DEFAULT_MS_BUCKETS",
+    "DeviceProfiler",
+    "FlightRecorder",
+    "MetricsServer",
+    "NULL_METRIC",
+    "NULL_REGISTRY",
+    "Registry",
+    "Span",
+    "SpanTracer",
+    "active_tracer",
+    "dump_registry",
+    "enabled",
+    "get_profiler",
+    "get_registry",
+    "log_buckets",
+    "parse_prometheus",
+    "render_prometheus",
+    "scoped_registry",
+    "scrape",
+    "set_enabled",
+    "set_profiler",
+    "set_registry",
+    "span",
+    "start_span",
+]

@@ -1,0 +1,238 @@
+"""Hierarchical span tracing with Chrome/Perfetto trace-event export.
+
+The flat `phases_ms` dict the round trace carried (runtime/trace.py)
+could say *that* a round spent 7 ms in "solve" but not *where*: graph
+export vs backend dispatch vs rung fallback vs decode. Spans make the
+nesting first-class — round → schedule → {stats, graph_update, solve →
+{graph_export, backend_solve → solver_rung…}, deltas, apply} — and the
+whole tree exports as Chrome trace-event JSON that loads directly in
+Perfetto / chrome://tracing.
+
+Two-layer design, so instrumentation costs ~nothing when unused:
+
+- `span(name, **args)` is a context manager that ALWAYS times (two
+  `perf_counter` calls — exactly what the hand-rolled timing it
+  replaces cost). `RoundTiming` in scheduler/flow_scheduler.py is
+  populated from these spans' durations, which is what makes the round
+  trace a *consumer* of the same measurements the live trace exports:
+  the JSONL artifact and a captured Perfetto trace can never disagree.
+- recording only happens while a `SpanTracer` is installed
+  (`tracer.install()` / `with tracer:`); with none installed the span
+  skips the contextvar parenting entirely.
+
+Parenting is contextvar-based, so spans nest correctly across threads
+and (if the host app uses them) asyncio tasks; each recorded event
+carries its span id and parent span id in `args` in addition to the
+time containment Perfetto uses for visual nesting. A span that exits
+via an exception records `args.error` and still closes cleanly, so an
+aborted round leaves a well-formed trace behind (the flight recorder
+depends on that).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+_current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "ksched_obs_span", default=None
+)
+_active: Optional["SpanTracer"] = None
+_ids = itertools.count(1)
+
+
+class Span:
+    """One timed region. Use as a context manager, or manually via
+    `start_span()` / `.finish()` when the region spans methods (the
+    pipelined round's dispatch→finish gap)."""
+
+    __slots__ = (
+        "name", "args", "sid", "parent_sid", "parent_name",
+        "t0_s", "t1_s", "dur_s", "_token", "_tracer",
+    )
+
+    def __init__(self, name: str, args: Optional[Dict] = None) -> None:
+        self.name = name
+        self.args = args
+        self.sid = 0
+        self.parent_sid = 0
+        self.parent_name: Optional[str] = None
+        self.t0_s = 0.0
+        self.t1_s = 0.0
+        self.dur_s = 0.0
+        self._token = None
+        self._tracer: Optional[SpanTracer] = None
+
+    def set(self, key: str, value) -> None:
+        """Attach an arg after entry (e.g. a superstep count only known
+        once the solve returns)."""
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+    def __enter__(self) -> "Span":
+        tracer = _active
+        self._tracer = tracer
+        if tracer is not None:
+            parent = _current.get()
+            self.sid = next(_ids)
+            if parent is not None:
+                self.parent_sid = parent.sid
+                self.parent_name = parent.name
+            self._token = _current.set(self)
+        self.t0_s = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self.t1_s:
+            return False  # already closed (error-path re-close is a no-op)
+        t1 = time.perf_counter()
+        self.t1_s = t1
+        self.dur_s = t1 - self.t0_s
+        tracer = self._tracer
+        if tracer is not None:
+            _current.reset(self._token)
+            self._token = None
+            if exc_type is not None:
+                self.set("error", f"{exc_type.__name__}: {exc}")
+            tracer._record(self)
+        return False
+
+    def finish(self) -> float:
+        """Close a manually-started span; returns its duration."""
+        self.__exit__(None, None, None)
+        return self.dur_s
+
+
+def span(name: str, **args) -> Span:
+    """Open a (not-yet-entered) span; `with span("solve") as sp:`."""
+    return Span(name, args or None)
+
+
+def start_span(name: str, **args) -> Span:
+    """Enter a span immediately (manual-finish form)."""
+    return Span(name, args or None).__enter__()
+
+
+def active_tracer() -> Optional["SpanTracer"]:
+    return _active
+
+
+def unwind(outer: Span, exc_type, exc, tb) -> None:
+    """Error-path close for manual-span regions: close every open span
+    from the current innermost up to and including `outer`, so the
+    error is recorded on each and the contextvar parenting is restored
+    for whatever runs next on this thread. A span entered with no
+    tracer installed never touched the contextvar — then only `outer`
+    itself needs closing (for its duration; nothing records)."""
+    if outer._tracer is None or outer.t1_s:
+        outer.__exit__(exc_type, exc, tb)
+        return
+    while True:
+        cur = _current.get()
+        if cur is None or cur.t1_s:
+            # chain unexpectedly broken; still close outer
+            outer.__exit__(exc_type, exc, tb)
+            return
+        done = cur is outer
+        cur.__exit__(exc_type, exc, tb)
+        if done:
+            return
+
+
+class SpanTracer:
+    """Collects finished spans as Chrome trace events in a bounded ring.
+
+    `mark()`/`events_since(mark)` slice out one round's spans for the
+    flight recorder; `chrome_trace()`/`dump()` export the whole ring
+    for Perfetto. Thread-safe: spans finish on whichever thread ran
+    them (the service thread, watch threads, the watchdog timer)."""
+
+    def __init__(self, capacity: int = 1 << 16) -> None:
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self.capacity = capacity
+        self.total = 0  # spans ever recorded (ring may have dropped some)
+        self.dropped = 0
+        self._prev: Optional[SpanTracer] = None
+
+    # -- recording ---------------------------------------------------------
+
+    def _record(self, sp: Span) -> None:
+        args = dict(sp.args) if sp.args else {}
+        args["sid"] = sp.sid
+        if sp.parent_sid:
+            args["parent_sid"] = sp.parent_sid
+            args["parent"] = sp.parent_name
+        event = {
+            "ph": "X",
+            "cat": "ksched",
+            "name": sp.name,
+            "ts": sp.t0_s * 1e6,  # perf_counter base: monotonic, shared in-process
+            "dur": (sp.t1_s - sp.t0_s) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": args,
+        }
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(event)
+            self.total += 1
+
+    # -- slicing (flight recorder) -----------------------------------------
+
+    def mark(self) -> int:
+        with self._lock:
+            return self.total
+
+    def events_since(self, mark: int) -> List[dict]:
+        """Events recorded after `mark` (oldest may be lost to the ring;
+        what remains is returned). islice, not a full-ring copy: the
+        flight recorder calls this every round to slice out the last
+        ~dozen events of a ring that may hold 64k."""
+        with self._lock:
+            want = self.total - mark
+            skip = max(0, len(self._events) - want)
+            return list(itertools.islice(self._events, skip, None))
+
+    # -- export ------------------------------------------------------------
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> dict:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+    # -- activation --------------------------------------------------------
+
+    def install(self) -> "SpanTracer":
+        """Make this the process-active tracer (stacking: uninstall
+        restores the previous one)."""
+        global _active
+        self._prev = _active
+        _active = self
+        return self
+
+    def uninstall(self) -> None:
+        global _active
+        if _active is self:
+            _active = self._prev
+        self._prev = None
+
+    def __enter__(self) -> "SpanTracer":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

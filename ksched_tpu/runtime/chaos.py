@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..cluster.api import Binding, ClusterAPI, NodeEvent, PodEvent
+from ..obs.metrics import get_registry
 
 #: solver fault kinds the injector can schedule (see degrade.py)
 SOLVER_FAULT_KINDS = ("nonconverge", "exception", "nan_cost")
@@ -109,6 +110,14 @@ class FaultInjector:
         self._rng_flap = np.random.default_rng(streams[3])
         self._rng_http = np.random.default_rng(streams[4])
         self.counters: Counter = Counter()
+        # live twin of `counters` on the obs registry: the obs smoke
+        # reconciles this against the tracer's per-round attribution
+        # (handles resolve at construction time; scoped_registry works)
+        self._m_injected = get_registry().counter(
+            "ksched_chaos_injected_total",
+            "faults injected by the chaos harness, by kind",
+            labelnames=("kind",),
+        )
         self.round_index = -1
         self._outage_rounds_left = 0
         #: this round's solver plan: {} | {rung 0: kind} | {all rungs: kind}
@@ -116,6 +125,13 @@ class FaultInjector:
         self._solver_plan_all = False
         self._flaps: Dict[int, int] = {}  # machine key -> silent rounds left
         self._quiesced = False
+
+    def _count(self, kind: str, n: int = 1) -> None:
+        """Count one injected fault, in both accounting surfaces: the
+        deterministic Counter (soak determinism asserts compare it
+        bit-for-bit) and the live metrics registry."""
+        self.counters[kind] += n
+        self._m_injected.labels(kind=kind).inc(n)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -162,13 +178,13 @@ class FaultInjector:
 
     def note_outage_round(self) -> None:
         """Count one suppressed batch poll (called by ChaosClusterAPI)."""
-        self.counters["api_outage_round"] += 1
+        self._count("api_outage_round")
 
     def drop_binding(self) -> bool:
         if self._quiesced or self.policy.binding_drop_prob <= 0:
             return False
         if self._rng_bind.random() < self.policy.binding_drop_prob:
-            self.counters["binding_drop"] += 1
+            self._count("binding_drop")
             return True
         return False
 
@@ -180,15 +196,15 @@ class FaultInjector:
         left = self._flaps.get(machine_key, 0)
         if left > 0:
             self._flaps[machine_key] = left - 1
-            self.counters["machine_flap_round"] += 1
+            self._count("machine_flap_round")
             return True
         if self._quiesced or self.policy.machine_flap_prob <= 0:
             return False
         if self._rng_flap.random() < self.policy.machine_flap_prob:
             lo, hi = self.policy.machine_flap_rounds
             self._flaps[machine_key] = int(self._rng_flap.integers(lo, hi + 1)) - 1
-            self.counters["machine_flap"] += 1
-            self.counters["machine_flap_round"] += 1
+            self._count("machine_flap")
+            self._count("machine_flap_round")
             return True
         return False
 
@@ -203,7 +219,7 @@ class FaultInjector:
         else:
             kind = self._solver_plan.get(rung_index)
         if kind is not None:
-            self.counters[f"solver_{kind}"] += 1
+            self._count(f"solver_{kind}")
         return kind
 
     # -- HTTP faults (the fake API server hook) ---------------------------
@@ -218,16 +234,16 @@ class FaultInjector:
         p = self.policy
         r = self._rng_http.random()
         if r < p.http_error_prob:
-            self.counters["http_error"] += 1
+            self._count("http_error")
             return {"kind": "error", "code": 503}
         r -= p.http_error_prob
         if r < p.http_hang_prob:
-            self.counters["http_hang"] += 1
+            self._count("http_hang")
             return {"kind": "hang", "seconds": p.http_hang_s}
         r -= p.http_hang_prob
         if r < p.http_latency_prob:
             lo, hi = p.http_latency_s
-            self.counters["http_latency"] += 1
+            self._count("http_latency")
             return {
                 "kind": "latency",
                 "seconds": float(lo + (hi - lo) * self._rng_http.random()),

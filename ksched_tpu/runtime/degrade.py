@@ -22,6 +22,7 @@ import warnings
 from typing import Callable, List, Optional, Tuple
 
 from ..graph.device_export import FlowProblem
+from ..obs.metrics import get_registry
 from ..solver.base import FlowResult, FlowSolver
 from .chaos import ChaosBackendError, FaultInjector, poison_costs
 
@@ -65,6 +66,22 @@ class DegradingSolver(FlowSolver):
         self.last_degradations = 0
         self.last_rung = -1
         self.last_rung_name: Optional[str] = None
+        # obs handles resolve at construction time (scoped_registry works)
+        reg = get_registry()
+        self._m_degradations = reg.counter(
+            "ksched_degradations_total",
+            "solver rungs stepped down, by the rung that failed",
+            labelnames=("rung",),
+        )
+        self._m_exhausted = reg.counter(
+            "ksched_ladder_exhausted_total",
+            "rounds on which every solver rung failed (NOOP rounds)",
+        )
+        self._m_rung = reg.gauge(
+            "ksched_solver_rung",
+            "ladder rung that produced the last solve (-1 = none yet)",
+        )
+        self._m_rung.set(self.last_rung)  # -1 until the first solve lands
 
     # -- rung access -------------------------------------------------------
 
@@ -102,11 +119,14 @@ class DegradingSolver(FlowSolver):
                     raise RuntimeError(f"chaos: forced non-convergence ({name})")
                 if fault == "nan_cost":
                     p = poison_costs(problem)
-                result = self._backend(i).solve(p)
+                # solve_traced: each rung attempt — including a failing
+                # one — is a nested backend_solve span in the trace
+                result = self._backend(i).solve_traced(p)
             except DEGRADABLE_ERRORS as e:
                 failures.append((name, e))
                 self.degradations_total += 1
                 self.last_degradations += 1
+                self._m_degradations.labels(rung=name).inc()
                 nxt = self._rungs[i + 1][0] if i + 1 < len(self._rungs) else None
                 warnings.warn(
                     f"solver rung {name!r} failed ({e}); "
@@ -117,7 +137,9 @@ class DegradingSolver(FlowSolver):
                 continue
             self.last_rung = i
             self.last_rung_name = name
+            self._m_rung.set(i)
             return result
+        self._m_exhausted.inc()
         raise LadderExhausted(failures)
 
     def reset(self) -> None:

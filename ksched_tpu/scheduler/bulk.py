@@ -35,13 +35,13 @@ costmodels.coco.coco_cost_matrix / costmodels.whare.whare_cost_matrix.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..graph.device_export import FlowProblem
+from ..obs.spans import span, unwind
 from ..solver.base import FlowSolver
 from ..utils import next_pow2
 
@@ -366,25 +366,26 @@ class BulkCluster:
         if hasattr(self.backend, "solve_layered"):
             return self._round_layered()
         timing: Dict[str, float] = {}
-        t0 = time.perf_counter()
-        self._refresh_capacities()
-        # Placed tasks are pinned: zero their graph presence (their slot
-        # stays accounted via pu_running, mirroring pinTaskToNode +
-        # capacity accounting with preemption off).
-        timing["stats_s"] = time.perf_counter() - t0
+        with span("round", path="bulk"):
+            with span("stats") as sp:
+                self._refresh_capacities()
+                # Placed tasks are pinned: zero their graph presence
+                # (their slot stays accounted via pu_running, mirroring
+                # pinTaskToNode + capacity accounting, preemption off).
+            timing["stats_s"] = sp.dur_s
 
-        t0 = time.perf_counter()
-        problem = self._problem()
-        result = self.backend.solve(problem)
-        timing["solve_s"] = time.perf_counter() - t0
+            with span("solve") as sp:
+                problem = self._problem()
+                result = self.backend.solve_traced(problem)
+            timing["solve_s"] = sp.dur_s
 
-        t0 = time.perf_counter()
-        placed_tasks, placed_pus, num_unsched = self._decode(result.flow)
-        timing["decode_s"] = time.perf_counter() - t0
+            with span("decode") as sp:
+                placed_tasks, placed_pus, num_unsched = self._decode(result.flow)
+            timing["decode_s"] = sp.dur_s
 
-        t0 = time.perf_counter()
-        self._apply_placements(placed_tasks, placed_pus)
-        timing["apply_s"] = time.perf_counter() - t0
+            with span("apply") as sp:
+                self._apply_placements(placed_tasks, placed_pus)
+            timing["apply_s"] = sp.dur_s
         return BulkRoundResult(
             placed_tasks=placed_tasks,
             placed_pus=placed_pus,
@@ -419,11 +420,24 @@ class BulkCluster:
         """The dense fast path: aggregate counts -> [C, M+1] transport
         solve -> rank-matched decode. Produces the same objective as the
         generic path (tasks within a class are cost-interchangeable)."""
-        from ..solver.layered import LayeredProblem
-
         timing: Dict[str, float] = {}
         M, C = self.M, self.C
-        t0 = time.perf_counter()
+        round_span = span("round", path="bulk_layered").__enter__()
+        try:
+            return self._round_layered_body(timing, M, C, round_span)
+        except BaseException:
+            # close whatever manual span is still open (stats/decode)
+            # plus the round span, so the error is recorded and the
+            # span parenting is restored for later rounds
+            import sys
+
+            unwind(round_span, *sys.exc_info())
+            raise
+
+    def _round_layered_body(self, timing, M, C, round_span):
+        from ..solver.layered import LayeredProblem
+
+        sp = span("stats").__enter__()
         self._refresh_capacities()  # keeps arrays/costs consistent for
         # checkpoints and for any later generic-path round
         pu_free = self.S - self.pu_running
@@ -461,13 +475,13 @@ class BulkCluster:
                 ec_cost=self.ec_cost,
             )
             row_of_task = cls
-        timing["stats_s"] = time.perf_counter() - t0
+        timing["stats_s"] = sp.finish()
 
-        t0 = time.perf_counter()
-        res = self.backend.solve_layered(lp)
-        timing["solve_s"] = time.perf_counter() - t0
+        with span("solve", path="layered") as sp:
+            res = self.backend.solve_layered(lp)
+        timing["solve_s"] = sp.dur_s
 
-        t0 = time.perf_counter()
+        sp = span("decode").__enter__()
         y = res.y  # int64[G, M]
         placed_per_row = y.sum(axis=1)
         # Stage 1 — pick which tasks place (any within-row choice is
@@ -500,11 +514,12 @@ class BulkCluster:
         placed_pus = np.empty(len(placed_rows), dtype=np.int32)
         placed_pus[order] = (self.pu0 + pu_grants).astype(np.int32)
         placed_tasks = (self.task0 + placed_rows).astype(np.int32)
-        timing["decode_s"] = time.perf_counter() - t0
+        timing["decode_s"] = sp.finish()
 
-        t0 = time.perf_counter()
-        self._apply_placements(placed_tasks, placed_pus)
-        timing["apply_s"] = time.perf_counter() - t0
+        with span("apply") as sp:
+            self._apply_placements(placed_tasks, placed_pus)
+        timing["apply_s"] = sp.dur_s
+        round_span.finish()
         return BulkRoundResult(
             placed_tasks=placed_tasks,
             placed_pus=placed_pus,

@@ -10,7 +10,7 @@ is: compute topology statistics → add/update job nodes → solve → deltas
 
 from __future__ import annotations
 
-import time
+import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
@@ -30,6 +30,7 @@ from ..data import (
 )
 from ..graph.changes import ChangeStats
 from ..graph.graph_manager import GraphManager, TaskMapping
+from ..obs.spans import span, start_span
 from ..solver.base import FlowSolver
 from ..solver.cpu_ref import ReferenceSolver
 from ..solver.placement import PlacementSolver
@@ -40,7 +41,12 @@ from ..utils import JobMap, ResourceMap, TaskMap, job_id_from_string, resource_i
 class RoundTiming:
     """Per-phase wall-clock breakdown of one scheduling round (the
     reference only times the whole round ad hoc in its CLI,
-    cmd/k8sscheduler/scheduler.go:146-150; we make phases first-class)."""
+    cmd/k8sscheduler/scheduler.go:146-150; we make phases first-class).
+
+    Every field is the duration of an obs span (`round` → `stats`,
+    `graph_update`, `solve`, `deltas`, `apply`), so the RoundRecord
+    JSONL (runtime/trace.py) and a captured Perfetto trace are two
+    views of the same measurement and can never disagree."""
 
     stats_s: float = 0.0
     graph_update_s: float = 0.0
@@ -104,8 +110,8 @@ class FlowScheduler:
         self.jobs_to_schedule: Dict[int, JobDescriptor] = {}
         self.runnable_tasks: Dict[int, Set[int]] = {}
         self.last_timing = RoundTiming()
-        #: pipelined-round state: (solver token, timing, round t0) while
-        #: a dispatched solve is in flight, else None
+        #: pipelined-round state: (solver token, timing, round span)
+        #: while a dispatched solve is in flight, else None
         self._round_in_flight = None
 
     # ------------------------------------------------------------------
@@ -272,11 +278,15 @@ class FlowScheduler:
         ]
         if not jds:
             return None
-        timing, t_round = self._begin_round(jds)
-        t0 = time.perf_counter()
-        token = self.solver.solve_async()
-        timing.solve_s = time.perf_counter() - t0  # dispatch only
-        self._round_in_flight = (token, timing, t_round)
+        timing, round_span = self._begin_round(jds)
+        try:
+            with span("solve_dispatch") as sp:
+                token = self.solver.solve_async()
+            timing.solve_s = sp.dur_s  # dispatch only
+        except BaseException:
+            round_span.__exit__(*sys.exc_info())
+            raise
+        self._round_in_flight = (token, timing, round_span)
         return token
 
     def finish_scheduling(self):
@@ -284,73 +294,88 @@ class FlowScheduler:
         round. Returns (num_scheduled, deltas) like schedule_jobs."""
         if self._round_in_flight is None:
             raise RuntimeError("no scheduling round in flight")
-        token, timing, t_round = self._round_in_flight
-        t0 = time.perf_counter()
+        token, timing, round_span = self._round_in_flight
         try:
-            task_mappings = self.solver.complete(token)
-        finally:
-            # the latch must clear even when the solver raises
-            # (overflow / non-convergence), or every later event
-            # handler would refuse with "in flight" forever — and it
-            # must be off before delta application anyway, for the
-            # internal placement/eviction handlers
-            self._round_in_flight = None
-        timing.solve_s += time.perf_counter() - t0  # + synchronize
-        return self._finish_round(task_mappings, timing, t_round)
+            try:
+                with span("solve_sync") as sp:
+                    task_mappings = self.solver.complete(token)
+            finally:
+                # the latch must clear even when the solver raises
+                # (overflow / non-convergence), or every later event
+                # handler would refuse with "in flight" forever — and it
+                # must be off before delta application anyway, for the
+                # internal placement/eviction handlers
+                self._round_in_flight = None
+            timing.solve_s += sp.dur_s  # + synchronize
+            return self._finish_round(task_mappings, timing, round_span)
+        except BaseException:
+            round_span.__exit__(*sys.exc_info())
+            raise
 
     def _begin_round(self, jds):
         """The pre-solve half of a round, shared by the synchronous
         and pipelined paths: mutation-counter reset, topology stats
-        refresh, and the job/task graph update."""
+        refresh, and the job/task graph update. Opens the `round` span
+        (closed by _finish_round — or here, on an exception)."""
         timing = RoundTiming()
-        t_round = time.perf_counter()
-        # Reset the mutation counters at round START (the reference
-        # resets after the round, flowscheduler/scheduler.go:332,
-        # which zeroes them before any post-round reader — e.g. the
-        # round tracer — can observe the round's mutation counts).
-        self.dimacs_stats.reset()
-        t0 = time.perf_counter()
-        self.gm.compute_topology_statistics(self.gm.sink_node)
-        timing.stats_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        self.gm.add_or_update_job_nodes(jds)
-        timing.graph_update_s = time.perf_counter() - t0
-        return timing, t_round
+        round_span = start_span("round", jobs=len(jds))
+        try:
+            # Reset the mutation counters at round START (the reference
+            # resets after the round, flowscheduler/scheduler.go:332,
+            # which zeroes them before any post-round reader — e.g. the
+            # round tracer — can observe the round's mutation counts).
+            self.dimacs_stats.reset()
+            with span("stats") as sp:
+                self.gm.compute_topology_statistics(self.gm.sink_node)
+            timing.stats_s = sp.dur_s
+            with span("graph_update") as sp:
+                self.gm.add_or_update_job_nodes(jds)
+            timing.graph_update_s = sp.dur_s
+        except BaseException:
+            round_span.__exit__(*sys.exc_info())
+            raise
+        return timing, round_span
 
-    def _finish_round(self, task_mappings, timing, t_round):
+    def _finish_round(self, task_mappings, timing, round_span):
         """The post-solve half of a round, shared by the synchronous
         and pipelined paths (so delta decoding / feedback can never
         drift between them): preemption deltas + binding diffs, delta
         application, per-root topology refresh, EC purge, and the
-        unscheduled-feedback hook."""
-        t0 = time.perf_counter()
-        deltas = self.gm.scheduling_deltas_for_preempted_tasks(
-            task_mappings, self.resource_map
-        )
-        for task_node_id, res_node_id in task_mappings.items():
-            delta = self.gm.node_binding_to_scheduling_delta(
-                task_node_id, res_node_id, self.task_bindings
-            )
-            if delta is not None:
-                deltas.append(delta)
-        timing.deltas_s = time.perf_counter() - t0
+        unscheduled-feedback hook. Closes the `round` span; its
+        duration IS timing.total_s."""
+        try:
+            with span("deltas") as sp:
+                deltas = self.gm.scheduling_deltas_for_preempted_tasks(
+                    task_mappings, self.resource_map
+                )
+                for task_node_id, res_node_id in task_mappings.items():
+                    delta = self.gm.node_binding_to_scheduling_delta(
+                        task_node_id, res_node_id, self.task_bindings
+                    )
+                    if delta is not None:
+                        deltas.append(delta)
+            timing.deltas_s = sp.dur_s
 
-        t0 = time.perf_counter()
-        num_scheduled = self._apply_scheduling_deltas(deltas)
-        for rid in self.resource_roots:
-            self.gm.update_resource_topology(self._root_rtnds[rid])
-        timing.apply_s = time.perf_counter() - t0
-        self.gm.purge_unconnected_equiv_class_nodes()
-        # Policy feedback: which runnable tasks stayed unscheduled
-        # (drives e.g. Quincy's wait-cost starvation bound).
-        unscheduled = [
-            t
-            for tasks in self.runnable_tasks.values()
-            for t in tasks
-            if t not in self.task_bindings
-        ]
-        self.cost_model.note_round(unscheduled)
-        timing.total_s = time.perf_counter() - t_round
+            with span("apply") as sp:
+                num_scheduled = self._apply_scheduling_deltas(deltas)
+                for rid in self.resource_roots:
+                    self.gm.update_resource_topology(self._root_rtnds[rid])
+            timing.apply_s = sp.dur_s
+            self.gm.purge_unconnected_equiv_class_nodes()
+            # Policy feedback: which runnable tasks stayed unscheduled
+            # (drives e.g. Quincy's wait-cost starvation bound).
+            unscheduled = [
+                t
+                for tasks in self.runnable_tasks.values()
+                for t in tasks
+                if t not in self.task_bindings
+            ]
+            self.cost_model.note_round(unscheduled)
+        except BaseException:
+            round_span.__exit__(*sys.exc_info())
+            raise
+        round_span.set("num_scheduled", num_scheduled)
+        timing.total_s = round_span.finish()
         self.last_timing = timing
         return num_scheduled, deltas
 
@@ -369,12 +394,16 @@ class FlowScheduler:
             timing = RoundTiming()
             self.last_timing = timing
             return 0, []
-        timing, t_round = self._begin_round(jds)
-        # Reference round body: flowscheduler/scheduler.go:340-375.
-        t0 = time.perf_counter()
-        task_mappings = self.solver.solve()
-        timing.solve_s = time.perf_counter() - t0
-        return self._finish_round(task_mappings, timing, t_round)
+        timing, round_span = self._begin_round(jds)
+        try:
+            # Reference round body: flowscheduler/scheduler.go:340-375.
+            with span("solve") as sp:
+                task_mappings = self.solver.solve()
+            timing.solve_s = sp.dur_s
+            return self._finish_round(task_mappings, timing, round_span)
+        except BaseException:
+            round_span.__exit__(*sys.exc_info())
+            raise
 
     def _apply_scheduling_deltas(self, deltas: List[SchedulingDelta]) -> int:
         """Reference: flowscheduler/scheduler.go:377-412."""

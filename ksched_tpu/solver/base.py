@@ -68,5 +68,31 @@ class FlowSolver(abc.ABC):
     @abc.abstractmethod
     def solve(self, problem: FlowProblem) -> FlowResult: ...
 
+    def solve_traced(self, problem: FlowProblem) -> FlowResult:
+        """``solve()`` inside a ``backend_solve`` obs span carrying the
+        backend name, problem shape, and solver effort. This is the one
+        instrumentation seam shared by every backend — the placement
+        driver and the degradation ladder call it, so each rung attempt
+        (including a failing one, whose span records the error) is a
+        nested span in a captured trace. Costs two ``perf_counter``
+        reads when no tracer is installed."""
+        from ..obs.spans import span
+
+        with span(
+            "backend_solve",
+            backend=type(self).__name__,
+            nodes=int(problem.num_nodes),
+            arcs=int(problem.num_arcs),
+        ) as sp:
+            result = self.solve(problem)
+            work = int(result.iterations or 0) or int(
+                getattr(self, "last_iterations", 0)
+                or getattr(self, "last_supersteps", 0)
+                or 0
+            )
+            if work:
+                sp.set("supersteps", work)
+        return result
+
     def reset(self) -> None:
         """Drop warm-start state (e.g. after a full graph rebuild)."""

@@ -14,6 +14,8 @@ from typing import Dict
 
 from ..graph.device_export import DeviceGraphState
 from ..graph.graph_manager import GraphManager, TaskMapping
+from ..obs.devprof import get_profiler
+from ..obs.spans import span
 from .base import FlowSolver
 from .decode import flow_to_mapping
 
@@ -37,41 +39,64 @@ class PlacementSolver:
         (placement/solver.go:60-90). Backends without solve_async run
         synchronously here (the token then carries the result)."""
         gm = self.gm
-        if not self._started or not self.incremental:
-            self._started = True
-            self.state.full_build(gm.cm.graph)
-            gm.cm.reset_changes()
-            self.backend.reset()
-        else:
-            gm.update_all_costs_to_unscheduled_aggs()
-            self.state.apply_changes(gm.cm.get_optimized_graph_changes())
-            gm.cm.reset_changes()
-        # Sink excess is maintained outside the journal (reference:
-        # graph_manager.go:636-640); sync it before each solve.
-        self.state.set_excess(gm.sink_node.id, gm.sink_node.excess)
-
-        problem = self.state.problem()
+        full = not self._started or not self.incremental
+        changes = None
+        with span("graph_export", kind="full_build" if full else "delta"):
+            if full:
+                self._started = True
+                self.state.full_build(gm.cm.graph)
+                gm.cm.reset_changes()
+                self.backend.reset()
+            else:
+                gm.update_all_costs_to_unscheduled_aggs()
+                changes = gm.cm.get_optimized_graph_changes()
+                self.state.apply_changes(changes)
+                gm.cm.reset_changes()
+            # Sink excess is maintained outside the journal (reference:
+            # graph_manager.go:636-640); sync it before each solve.
+            self.state.set_excess(gm.sink_node.id, gm.sink_node.excess)
+            problem = self.state.problem()
+        # Byte accounting from the journal just applied — NOT from the
+        # per-round ChangeStats, which miss the previous round's
+        # post-solve mutations (journaled after the round-start stats
+        # reset but shipped in this scatter).
+        get_profiler().note_export(problem, full=full, changes=changes)
         # Task nodes captured NOW: the decode must map the snapshot's
         # tasks, not tasks added while the solve is in flight.
         task_node_ids = [node.id for node in gm.task_to_node.values()]
-        if hasattr(self.backend, "solve_async"):
-            pending = self.backend.solve_async(problem)
-            return (problem, task_node_ids, pending, True)
-        return (problem, task_node_ids, self.backend.solve(problem), False)
+        get_profiler().solve_starting()
+        try:
+            if hasattr(self.backend, "solve_async"):
+                pending = self.backend.solve_async(problem)
+                return (problem, task_node_ids, pending, True)
+            return (problem, task_node_ids, self.backend.solve_traced(problem), False)
+        except BaseException:
+            get_profiler().solve_failed()  # stop an Nth-solve capture
+            raise
 
     def complete(self, token) -> TaskMapping:
         """Phase 2: synchronize the solve and decode the task mapping."""
         problem, task_node_ids, pending, is_async = token
-        result = self.backend.complete(pending) if is_async else pending
+        if is_async:
+            try:
+                with span("backend_solve", backend=type(self.backend).__name__):
+                    result = self.backend.complete(pending)
+            except BaseException:
+                get_profiler().solve_failed()  # stop an Nth-solve capture
+                raise
+        else:
+            result = pending
         self.last_result = result
+        get_profiler().note_solve(self.backend, problem, result)
         gm = self.gm
-        return flow_to_mapping(
-            problem,
-            result.total_flow(problem),
-            gm.leaf_node_ids,
-            gm.sink_node.id,
-            task_node_ids,
-        )
+        with span("decode", tasks=len(task_node_ids)):
+            return flow_to_mapping(
+                problem,
+                result.total_flow(problem),
+                gm.leaf_node_ids,
+                gm.sink_node.id,
+                task_node_ids,
+            )
 
     def solve(self) -> TaskMapping:
         return self.complete(self.solve_async())

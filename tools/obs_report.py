@@ -1,0 +1,190 @@
+"""Render an observability dump as a phase-percentile table.
+
+One reader for every artifact the obs subsystem writes, detected by
+shape — point it at whichever file a run left behind:
+
+- **RoundRecord JSONL** (`RoundTracer.dump`, `--round-trace`): exact
+  per-phase percentiles over the recorded rounds (idle sweeps
+  excluded, counted separately — runtime/trace.py summary semantics);
+- **registry snapshot JSON** (`dump_registry`, `--obs-dump`/`--obs-out`
+  or the live `/varz` body): percentiles *estimated* from the
+  `ksched_round_phase_ms` histogram buckets (log-linear interpolation
+  within a bucket), plus a counter table;
+- **flight-recorder dump** (`flight_<reason>_r*.json`): the ring's
+  embedded RoundRecords, exact percentiles as for JSONL;
+- **Chrome trace JSON** (`SpanTracer.dump`, `--trace-out`): per-span-
+  name duration percentiles over the trace events.
+
+Usage: python tools/obs_report.py DUMP [--phase total]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+PCTS = (50, 90, 99)
+
+
+def _row(name: str, vals) -> str:
+    v = np.asarray(vals, dtype=np.float64)
+    cells = [f"{np.percentile(v, p):10.3f}" for p in PCTS]
+    return (
+        f"{name:<24} {len(v):>7} " + " ".join(cells)
+        + f" {v.mean():10.3f} {v.max():10.3f}"
+    )
+
+
+def _header(unit: str = "ms") -> str:
+    cols = [f"p{p}_{unit}" for p in PCTS] + [f"mean_{unit}", f"max_{unit}"]
+    return f"{'phase':<24} {'n':>7} " + " ".join(f"{c:>10}" for c in cols)
+
+
+def report_records(records: list) -> None:
+    """Exact percentiles from RoundRecord dicts (JSONL / flight ring)."""
+    def is_idle(r):
+        return r.get("solver_rung", 0) == -1 and not r.get("noop_round")
+
+    idle = [r for r in records if is_idle(r)]
+    active = [r for r in records if not is_idle(r)]
+    print(f"rounds: {len(active)} (+{len(idle)} idle sweeps excluded)")
+    noops = sum(1 for r in active if r.get("noop_round"))
+    misses = sum(1 for r in active if r.get("deadline_miss"))
+    if noops or misses:
+        print(f"noop_rounds: {noops}  deadline_misses: {misses}")
+    faults: dict = {}
+    for r in records:
+        for k, v in (r.get("faults_injected") or {}).items():
+            faults[k] = faults.get(k, 0) + v
+    if faults:
+        print(f"faults: {dict(sorted(faults.items()))}")
+    if not active:
+        return
+    phases = sorted({p for r in active for p in r.get("phases_ms", {})})
+    print(_header())
+    for phase in phases:
+        print(_row(phase, [r["phases_ms"].get(phase, 0.0) for r in active]))
+
+
+def _hist_percentile(buckets: list, count: int, pct: float) -> float:
+    """Estimate a percentile from cumulative-ready [bound, n] bucket
+    pairs (n per-bucket, +Inf last) by interpolating within the
+    landing bucket. Standard Prometheus-style estimation: exact at
+    bucket bounds, log-linear inside."""
+    want = count * pct / 100.0
+    cum = 0.0
+    lo = 0.0
+    for bound, n in buckets:
+        prev = cum
+        cum += n
+        if cum >= want and n > 0:
+            if bound == "+Inf":
+                return float(lo)
+            b = float(bound)
+            frac = (want - prev) / n
+            return float(lo + (b - lo) * frac)
+        if bound != "+Inf":
+            lo = float(bound)
+    return float(lo)
+
+
+def report_snapshot(metrics: dict, phase_metric: str = "ksched_round_phase_ms") -> None:
+    """Histogram-estimated percentiles + counters from a registry
+    snapshot (`dump_registry` / the live `/varz` body)."""
+    fam = metrics.get(phase_metric)
+    if fam and fam.get("kind") == "histogram":
+        print(f"{phase_metric} (histogram-estimated):")
+        print(_header())
+        for sample in fam["samples"]:
+            name = ",".join(f"{k}={v}" for k, v in sorted(sample["labels"].items()))
+            count = sample["count"]
+            if not count:
+                continue
+            cells = [
+                f"{_hist_percentile(sample['buckets'], count, p):10.3f}"
+                for p in PCTS
+            ]
+            mean = sample["sum"] / count
+            print(
+                f"{name or '(all)':<24} {count:>7} " + " ".join(cells)
+                + f" {mean:10.3f} {'':>10}"
+            )
+        print()
+    print(f"{'counter/gauge':<44} {'value':>14}")
+    for name, fam in sorted(metrics.items()):
+        if fam.get("kind") == "histogram":
+            continue
+        for sample in fam["samples"]:
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(sample["labels"].items()))
+            series = name + (f"{{{lbl}}}" if lbl else "")
+            print(f"{series:<44} {sample['value']:>14g}")
+
+
+def report_trace(events: list) -> None:
+    """Per-span-name duration percentiles from Chrome trace events."""
+    by_name: dict = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_name.setdefault(ev["name"], []).append(ev.get("dur", 0.0) / 1e3)
+    print(f"trace: {len(events)} events, {len(by_name)} span names")
+    print(_header())
+    for name in sorted(by_name):
+        print(_row(name, by_name[name]))
+
+
+def load_and_report(path: str, phase_metric: str) -> None:
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        print("empty dump", file=sys.stderr)
+        return
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None  # multi-line JSONL: one record per line
+    if isinstance(doc, dict):
+        if "metrics" in doc:
+            report_snapshot(doc["metrics"], phase_metric)
+            return
+        if "rounds" in doc and isinstance(doc["rounds"], list):
+            print(f"flight dump: reason={doc.get('reason')} "
+                  f"rounds_seen={doc.get('rounds_seen')}")
+            report_records([entry["record"] for entry in doc["rounds"]])
+            return
+        if "traceEvents" in doc:
+            report_trace(doc["traceEvents"])
+            return
+        if doc and all(isinstance(v, dict) and "kind" in v for v in doc.values()):
+            report_snapshot(doc, phase_metric)  # bare /varz body
+            return
+    # fall through: RoundRecord JSONL
+    records = [json.loads(line) for line in text.splitlines() if line.strip()]
+    report_records(records)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="phase-percentile table from any obs dump"
+    )
+    ap.add_argument("dump", help="JSONL round trace, registry snapshot, "
+                    "flight dump, or Chrome trace JSON")
+    ap.add_argument("--phase-metric", default="ksched_round_phase_ms",
+                    help="histogram family to tabulate from snapshots")
+    args = ap.parse_args()
+    try:
+        load_and_report(args.dump, args.phase_metric)
+    except BrokenPipeError:
+        # piping into head/a pager closes stdout mid-table; that is a
+        # normal way to skim the output, not an error — point the fd at
+        # devnull so the interpreter's exit flush doesn't re-raise
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,13 +72,99 @@ def check_service_invariants(svc, where: str) -> None:
         )
 
 
+def reconcile_obs(served: dict, tracer, injector) -> None:
+    """Assert the LIVE Prometheus text (scraped over HTTP) agrees
+    exactly with the soak's two other accounting surfaces: the
+    injector's deterministic Counter and the summed RoundRecord JSONL.
+    Any drift between what the registry served and what the rounds
+    recorded is a bug in the publication path."""
+
+    def served_value(name, **labels):
+        return served.get((name, tuple(sorted(labels.items()))), 0.0)
+
+    for kind, n in injector.counters.items():
+        got = served_value("ksched_chaos_injected_total", kind=kind)
+        assert got == n, f"served chaos_injected[{kind}]={got} != injector {n}"
+    attributed: dict = {}
+    for rec in tracer.records:
+        for k, v in rec.faults_injected.items():
+            attributed[k] = attributed.get(k, 0) + v
+    for kind, n in attributed.items():
+        got = served_value("ksched_faults_attributed_total", kind=kind)
+        assert got == n, f"served faults_attributed[{kind}]={got} != records {n}"
+    checks = {
+        "ksched_retries_total": sum(r.retries for r in tracer.records),
+        "ksched_round_degradations_total": sum(
+            r.degradations for r in tracer.records
+        ),
+        "ksched_deadline_misses_total": sum(
+            1 for r in tracer.records if r.deadline_miss
+        ),
+        "ksched_machines_lost_total": sum(r.machines_lost for r in tracer.records),
+        "ksched_scheduled_tasks_total": sum(
+            r.num_scheduled for r in tracer.records
+        ),
+    }
+    for name, want in checks.items():
+        got = served_value(name)
+        assert got == want, f"served {name}={got} != summed records {want}"
+    kinds = {
+        "noop": sum(1 for r in tracer.records if r.noop_round),
+        "idle": sum(
+            1 for r in tracer.records if r.solver_rung == -1 and not r.noop_round
+        ),
+    }
+    kinds["sched"] = len(tracer.records) - kinds["noop"] - kinds["idle"]
+    for kind, want in kinds.items():
+        got = served_value("ksched_rounds_total", kind=kind)
+        assert got == want, f"served rounds_total[{kind}]={got} != {want}"
+
+
 def run_chaos_soak(args, log=print) -> dict:
     """Drive the SchedulerService for args.rounds rounds under a seeded
     fault schedule, single-threaded and in logical time (1 round = 1 s
     of heartbeat clock) so the whole run is deterministic. Returns the
-    final placements and fault totals for cross-run comparison."""
+    final placements and fault totals for cross-run comparison.
+
+    The run gets a PRIVATE metrics registry (scoped_registry) so its
+    counters start from zero — the determinism double-run would
+    otherwise accumulate in the process registry. With --metrics-port
+    the registry is served live during the run and scraped back over
+    HTTP at the end; reconcile_obs then asserts the served text, the
+    injector totals, and the summed RoundRecords agree exactly."""
+    from ksched_tpu.obs import scoped_registry
+
+    with scoped_registry() as reg:
+        return _run_chaos_soak_in_registry(args, reg, log)
+
+
+def _run_chaos_soak_in_registry(args, reg, log=print) -> dict:
+    from ksched_tpu.obs import DeviceProfiler, MetricsServer, set_profiler
+    from ksched_tpu.utils import seed_rng
+
+    seed_rng(args.seed)  # task/job/machine ids come from the global RNG
+    set_profiler(DeviceProfiler())  # per-run solve/export accounting
+    server = None
+    # getattr: callers (tests) build a bare Namespace without obs flags
+    metrics_port = getattr(args, "metrics_port", None)
+    if metrics_port is not None:
+        server = MetricsServer(port=metrics_port, registry=reg)
+        log(f"metrics: {server.url}/metricsz", flush=True)
+    try:
+        return _chaos_soak_body(args, reg, server, log)
+    finally:
+        # an invariant/reconcile assertion mid-run must not leak the
+        # HTTP thread or leave the module profiler pinned to this run's
+        # (popped) scoped registry for later in-process callers
+        set_profiler(None)
+        if server is not None:
+            server.stop()
+
+
+def _chaos_soak_body(args, reg, server, log=print) -> dict:
     from ksched_tpu.cli import SchedulerService
     from ksched_tpu.cluster import NodeEvent, PodEvent, SyntheticClusterAPI
+    from ksched_tpu.obs import dump_registry, scrape
     from ksched_tpu.runtime import (
         ChaosClusterAPI,
         ChaosPolicy,
@@ -86,9 +172,7 @@ def run_chaos_soak(args, log=print) -> dict:
         RoundTracer,
     )
     from ksched_tpu.solver.select import make_backend
-    from ksched_tpu.utils import seed_rng
 
-    seed_rng(args.seed)  # task/job/machine ids come from the global RNG
     policy = ChaosPolicy(
         seed=args.seed,
         api_outage_prob=0.04,
@@ -235,6 +319,20 @@ def run_chaos_soak(args, log=print) -> dict:
         f"degradations={degr} noop_rounds={noops} restores={restores} "
         f"final_bound={len(placements)}"
     )
+    if server is not None:
+        # scrape our own live endpoint (text format over a real socket)
+        # and reconcile it against the injector + the RoundRecord sums
+        # (the caller's finally stops the server)
+        served = scrape(server.url + "/metricsz")
+        reconcile_obs(served, tracer, injector)
+        log(
+            f"OBS RECONCILE OK: {len(served)} served series match the "
+            "injector totals and the summed RoundRecord JSONL"
+        )
+    obs_out = getattr(args, "obs_out", None)
+    if obs_out:
+        dump_registry(reg, obs_out)
+        log(f"obs: registry snapshot -> {obs_out}")
     return {
         "placements": placements,
         "all_bindings": dict(api.bindings()),
@@ -286,6 +384,13 @@ def main() -> int:
     ap.add_argument("--verify-determinism", action="store_true",
                     help="chaos mode: run twice, require identical "
                     "placements + fault totals")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="chaos mode: serve live Prometheus text on "
+                    "/metricsz during the soak (0 = ephemeral port) and "
+                    "reconcile the scraped text against the RoundRecord "
+                    "totals at exit (the obs smoke)")
+    ap.add_argument("--obs-out", metavar="PATH", default=None,
+                    help="write the metrics-registry snapshot JSON at exit")
     args = ap.parse_args()
     if args.machines is None:  # per-mode default (device soak vs chaos)
         args.machines = 10 if args.chaos else 500
