@@ -7,8 +7,15 @@
 #                     docs/robustness.md)
 #   make obs-smoke    short chaos soak serving live /metricsz; scrapes
 #                     its own endpoint and asserts the served counters
-#                     reconcile exactly with the RoundRecord totals
+#                     reconcile exactly with the RoundRecord totals,
+#                     AND that the seeded solver faults produced a
+#                     flight dump carrying the stall detector's
+#                     structured reason + telemetry tail
 #                     (docs/observability.md)
+#   make bench-gate   check BENCH_TRAJECTORY.jsonl: fail if any config's
+#                     newest p50 regressed >15% vs its previous entry
+#                     (tools/bench_compare.py; append runs with
+#                     `python tools/bench_compare.py append ... --from-bench`)
 #   make verify       lint, then tests, then the chaos + obs smokes
 #   make baseline     re-accept current lint violations (ratchet; avoid —
 #                     fix or suppress inline instead, docs/static_analysis.md)
@@ -18,7 +25,7 @@ SHELL := /bin/bash
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test chaos-smoke obs-smoke verify baseline
+.PHONY: lint test chaos-smoke obs-smoke bench-gate verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
@@ -29,9 +36,15 @@ chaos-smoke:
 	  --chaos-restore-every 48 --verify-determinism
 
 obs-smoke:
+	rm -rf /tmp/ksched_obs_smoke_flight
 	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/soak.py --chaos \
 	  --rounds 64 --chunk 32 --seed 3 --machines 6 --slots 8 \
-	  --chaos-restore-every 0 --metrics-port 0
+	  --chaos-restore-every 0 --metrics-port 0 \
+	  --flight-dir /tmp/ksched_obs_smoke_flight --solver-outage-prob 0.08 \
+	  --assert-stall-flight
+
+bench-gate:
+	$(PY) tools/bench_compare.py gate BENCH_TRAJECTORY.jsonl
 
 test:
 	set -o pipefail; rm -f /tmp/_t1.log; \

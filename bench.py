@@ -455,6 +455,12 @@ def _device_bench(
     }
     if ss_all:
         ss_cat = np.concatenate(ss_all)
+        # solver-interior telemetry for --obs-out: the fused device
+        # rounds expose per-round superstep counts through fetch_stats;
+        # publish them AFTER the clock stopped (hot loop untouched)
+        from ksched_tpu.obs import soltel
+
+        soltel.publish_round_supersteps(ss_cat, backend=f"device/{platform}")
         detail["supersteps_p50"] = int(np.percentile(ss_cat, 50))
         detail["supersteps_p99"] = int(np.percentile(ss_cat, 99))
         detail["supersteps_max"] = int(ss_cat.max())
@@ -1037,6 +1043,11 @@ def _quincy_multiblock_bench(
         ss_all.append(np.asarray(got["supersteps"]))
         if "active_groups" in got:
             act_all.append(np.asarray(got["active_groups"]))
+    from ksched_tpu.obs import soltel
+
+    soltel.publish_round_supersteps(
+        np.concatenate(ss_all), backend=f"device/{platform}"
+    )
 
     # ---- untimed quality segment: capped table vs exact diversity ----
     solver = LayeredTransportSolver(max_supersteps=1 << 17)
@@ -1413,6 +1424,11 @@ def _gtrace_device_bench(
         ss_all.append(np.asarray(got["supersteps"]))
         evicted += int(got["evicted"].sum())
         placed += int(got["placed"].sum())
+    from ksched_tpu.obs import soltel
+
+    soltel.publish_round_supersteps(
+        np.concatenate(ss_all), backend=f"device/{platform}"
+    )
     p50 = float(np.percentile(per_round_ms, 50))
     target_ms = 10.0
     detail = {
@@ -1682,12 +1698,22 @@ def main():
             reg = get_registry()
             dump_registry(reg, args.obs_out)
             print(f"# obs: registry snapshot -> {args.obs_out}", file=sys.stderr)
-            if not reg.collect():
+            fams = {f.name for f in reg.collect()}
+            if not fams:
                 print(
-                    "# obs: WARNING: the registry snapshot is empty — round "
-                    "metrics are published by the host bulk/layered bench "
-                    "paths (--cpu --backend native/ref/layered), not the "
-                    "device or --config paths",
+                    "# obs: WARNING: the registry snapshot is empty — "
+                    "enable obs (drop KSCHED_OBS=0/--no-obs) to record",
+                    file=sys.stderr,
+                )
+            elif "ksched_solve_supersteps" not in fams:
+                # device-fused paths and the compiled host backends all
+                # publish solver-interior telemetry now; only backends
+                # that genuinely expose none land here
+                print(
+                    "# obs: WARNING: no solver-interior telemetry was "
+                    "recorded — the native/cpu_ref backends expose no "
+                    "superstep counters (docs/observability.md, Solver "
+                    "interior)",
                     file=sys.stderr,
                 )
 

@@ -141,8 +141,31 @@ class ContractReport:
         return not self.scatter_eqns
 
 
+_SRC_INFO_RE = None
+
+
+def _normalize_jaxpr_str(text: str) -> str:
+    """Strip trace metadata that varies without the PROGRAM changing:
+    `origin='...'` operand labels and `... at /path/file.py:NN` source
+    infos (pallas embeds both in its jaxpr params — a comment edit
+    above a kernel would otherwise split the hash)."""
+    global _SRC_INFO_RE
+    if _SRC_INFO_RE is None:
+        import re
+
+        _SRC_INFO_RE = (
+            re.compile(r"origin='[^']*'"),
+            re.compile(r" at [^\s,)]+\.py:\d+"),
+        )
+    for pat in _SRC_INFO_RE:
+        text = pat.sub("", text)
+    return text
+
+
 def jaxpr_hash(closed) -> str:
-    return hashlib.sha256(str(closed).encode()).hexdigest()[:16]
+    return hashlib.sha256(
+        _normalize_jaxpr_str(str(closed)).encode()
+    ).hexdigest()[:16]
 
 
 def check_jaxpr(backend: str, closed, shape_key: Tuple = ()) -> ContractReport:
@@ -189,15 +212,21 @@ class MegaVmemEstimate:
     io_tiles: int  # VMEM [R, L] operands (inputs + outputs) of the kernel
     smem_operands: int
     io_bytes: int
-    est_tiles: int  # io_tiles + MEGA_SCAN_TEMP_TILES
+    est_tiles: int  # io_tiles + MEGA_SCAN_TEMP_TILES + extra_tiles
     est_bytes: int
     gate_tiles: int  # _MEGA_LIVE_TILES, what mega_fits_vmem budgets with
     all_operands_on_chip: bool  # no ANY/HBM-spec'd kernel operands
+    #: tile-equivalents of VMEM operands that are NOT [R, L] entry
+    #: tiles (the solver-telemetry ring), rounded up — with telemetry
+    #: on this is exactly 1 (the ring is clamped to one tile)
+    extra_tiles: int = 0
 
     @property
     def gate_is_safe(self) -> bool:
-        """The gate budgets at least the kernel's real live set."""
-        return self.gate_tiles >= self.est_tiles
+        """The gate budgets at least the kernel's real live set (the
+        telemetry ring's +1 tile is charged by
+        mega_fits_vmem(telemetry=True), mirrored here)."""
+        return self.gate_tiles + (1 if self.extra_tiles else 0) >= self.est_tiles
 
     @property
     def gate_is_tight(self) -> bool:
@@ -227,20 +256,34 @@ def estimate_mega_vmem(closed) -> MegaVmemEstimate:
         else:
             on_chip = False
     assert vmem_shapes, "mega kernel has no VMEM operands?"
-    tile_shapes = {s for s in vmem_shapes if len(s) == 2}
-    assert len(tile_shapes) == 1, f"mixed VMEM tile shapes: {tile_shapes}"
-    (R, L), = tile_shapes
-    io_tiles = len(vmem_shapes)
-    est_tiles = io_tiles + MEGA_SCAN_TEMP_TILES
+    # the [R, L] entry tiling is the DOMINANT 2-D shape; any other VMEM
+    # operand (the clamped solver-telemetry ring) is charged in
+    # tile-equivalents, rounded up — mega_telemetry_cap bounds the ring
+    # to one tile, so extra_tiles is 0 (telemetry off) or 1 (on)
+    from collections import Counter as _Counter
+
+    shape_counts = _Counter(s for s in vmem_shapes if len(s) == 2)
+    (R, L), _n = shape_counts.most_common(1)[0]
+    tile_bytes = int(R) * int(L) * 4
+    io_tiles = 0
+    extra_bytes = 0
+    for s in vmem_shapes:
+        if tuple(s) == (R, L):
+            io_tiles += 1
+        else:
+            extra_bytes += int(np.prod(s)) * 4
+    extra_tiles = -(-extra_bytes // tile_bytes) if extra_bytes else 0
+    est_tiles = io_tiles + MEGA_SCAN_TEMP_TILES + extra_tiles
     return MegaVmemEstimate(
         R=int(R), L=int(L),
         io_tiles=io_tiles,
         smem_operands=smem,
-        io_bytes=io_tiles * int(R) * int(L) * 4,
+        io_bytes=io_tiles * tile_bytes,
         est_tiles=est_tiles,
-        est_bytes=est_tiles * int(R) * int(L) * 4,
+        est_bytes=est_tiles * tile_bytes,
         gate_tiles=_MEGA_LIVE_TILES,
         all_operands_on_chip=on_chip,
+        extra_tiles=extra_tiles,
     )
 
 
@@ -272,12 +315,13 @@ def _generator_graph(n: int, m: int, seed: int = 0):
     return src, dst
 
 
-def trace_jax(n_raw: int, m_raw: int, seed: int = 0):
+def trace_jax(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
     from ..solver.jax_solver import _solve_mcmf
 
     n, m = bucketed_sizes(n_raw, m_raw)
     fn = functools.partial(
-        _solve_mcmf, alpha=8, max_supersteps=4096, tighten_sweeps=32
+        _solve_mcmf, alpha=8, max_supersteps=4096, tighten_sweeps=32,
+        telemetry_cap=telemetry_cap,
     )
     e = 2 * m
     return jax.make_jaxpr(fn)(
@@ -288,14 +332,15 @@ def trace_jax(n_raw: int, m_raw: int, seed: int = 0):
     )
 
 
-def trace_ell(n_raw: int, m_raw: int, seed: int = 0):
+def trace_ell(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
     from ..solver.ell_solver import _solve_mcmf_ell, build_ell_plan, _plan_args
 
     n, m = bucketed_sizes(n_raw, m_raw)
     src, dst = _generator_graph(n, m, seed)
     plan_args = build_ell_plan(src, dst, n)
     fn = functools.partial(
-        _solve_mcmf_ell, alpha=8, max_supersteps=4096, tighten_sweeps=32
+        _solve_mcmf_ell, alpha=8, max_supersteps=4096, tighten_sweeps=32,
+        telemetry_cap=telemetry_cap,
     )
     plan_sds = tuple(_sds(np.shape(x), np.asarray(x).dtype) for x in _plan_args(plan_args))
     return jax.make_jaxpr(fn)(
@@ -304,7 +349,7 @@ def trace_ell(n_raw: int, m_raw: int, seed: int = 0):
     )
 
 
-def trace_mega(n_raw: int, m_raw: int, seed: int = 0):
+def trace_mega(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
     from ..ops.mcmf_pallas import MEGA_LANES, mcmf_loop_pallas, mega_entry_rows
     from ..utils import next_pow2
 
@@ -318,7 +363,7 @@ def trace_mega(n_raw: int, m_raw: int, seed: int = 0):
     e = R * L
     fn = functools.partial(
         mcmf_loop_pallas, R=R, L=L, alpha=8, max_supersteps=4096,
-        tighten_sweeps=32, interpret=False,
+        tighten_sweeps=32, interpret=False, telemetry_cap=telemetry_cap,
     )
     return jax.make_jaxpr(fn)(
         _sds((mp,)), _sds((mp,)), _sds((npad,)), _sds((mp,)), _sds(()),
@@ -327,7 +372,7 @@ def trace_mega(n_raw: int, m_raw: int, seed: int = 0):
     )
 
 
-def trace_layered(n_raw: int, m_raw: int, seed: int = 0):
+def trace_layered(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
     """(n_raw, m_raw) doubles as (num_classes, num_machines): the
     layered backend's problem geometry."""
     from ..solver.layered import _solve_transport, pad_geometry
@@ -335,14 +380,15 @@ def trace_layered(n_raw: int, m_raw: int, seed: int = 0):
     C = max(1, n_raw)
     Mp, _n_scale = pad_geometry(m_raw, C)
     fn = functools.partial(
-        _solve_transport, alpha=8, max_supersteps=4096, refine_waves=0
+        _solve_transport, alpha=8, max_supersteps=4096, refine_waves=0,
+        telemetry_cap=telemetry_cap,
     )
     return jax.make_jaxpr(fn)(
         _sds((C, Mp)), _sds((C,)), _sds((Mp,)), _sds(()), _sds((Mp,))
     )
 
 
-def trace_sharded(n_raw: int, m_raw: int, seed: int = 0):
+def trace_sharded(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
     from jax.sharding import Mesh
 
     from ..parallel.sharded_solver import build_sharded_plan, make_sharded_solver
@@ -352,7 +398,9 @@ def trace_sharded(n_raw: int, m_raw: int, seed: int = 0):
     devices = np.array(jax.devices())
     mesh = Mesh(devices, ("x",))
     plan = build_sharded_plan(src, dst, n, len(devices))
-    fn = make_sharded_solver(mesh, "x", alpha=8, max_supersteps=4096)
+    fn = make_sharded_solver(
+        mesh, "x", alpha=8, max_supersteps=4096, telemetry_cap=telemetry_cap
+    )
     plan_sds = tuple(
         _sds(np.shape(x), np.asarray(x).dtype)
         for x in (
@@ -378,11 +426,14 @@ TRACERS = {
 
 
 @functools.lru_cache(maxsize=64)
-def traced(backend: str, n_raw: int, m_raw: int, seed: int = 0):
+def traced(backend: str, n_raw: int, m_raw: int, seed: int = 0,
+           telemetry_cap: int = 0):
     """Cached abstract trace: the contract tests revisit the same
     (backend, bucket) pairs, and tracing (the megakernel especially)
-    dominates the suite's tier-1 cost."""
-    return TRACERS[backend](n_raw, m_raw, seed)
+    dominates the suite's tier-1 cost. telemetry_cap traces the
+    solver-telemetry-ON program (obs/soltel.py); 0 is the baseline
+    pre-telemetry program."""
+    return TRACERS[backend](n_raw, m_raw, seed, telemetry_cap=telemetry_cap)
 
 
 def backend_report(backend: str, n_raw: int, m_raw: int, seed: int = 0) -> ContractReport:

@@ -15,7 +15,12 @@ Four pieces, threaded through every layer of the scheduling loop:
   counters, host→device bytes per round, opt-in `jax.profiler`
   capture around the Nth solve);
 - **flight** — a crash flight recorder: the last N rounds' records and
-  spans, auto-dumped on deadline miss, ladder exhaustion, or crash.
+  spans, auto-dumped on deadline miss, ladder exhaustion, or crash;
+- **soltel** — solver-interior telemetry: per-superstep device
+  counters (eps, active/excess, pushes, relabels, saturated arcs,
+  work) emitted by the compiled backends, decoded into the registry,
+  synthesized as per-superstep child spans, and fed to a structured
+  stall/divergence detector whose events ride in flight dumps.
 
 `KSCHED_OBS=0` (or `metrics.set_enabled(False)`) switches the global
 registry to an inert null registry; span timing still feeds
@@ -44,6 +49,15 @@ from .metrics import (
     set_enabled,
     set_registry,
 )
+from .soltel import (
+    SOLTEL_COLS,
+    SOLTEL_DEFAULT_CAP,
+    SOLTEL_TAIL,
+    SOLTEL_WIDTH,
+    SolverStallError,
+    SolveTelemetry,
+    detect_stall,
+)
 from .spans import Span, SpanTracer, active_tracer, span, start_span
 
 __all__ = [
@@ -54,9 +68,16 @@ __all__ = [
     "NULL_METRIC",
     "NULL_REGISTRY",
     "Registry",
+    "SOLTEL_COLS",
+    "SOLTEL_DEFAULT_CAP",
+    "SOLTEL_TAIL",
+    "SOLTEL_WIDTH",
+    "SolveTelemetry",
+    "SolverStallError",
     "Span",
     "SpanTracer",
     "active_tracer",
+    "detect_stall",
     "dump_registry",
     "enabled",
     "get_profiler",

@@ -104,12 +104,19 @@ class FlightRecorder:
             os.makedirs(parent, exist_ok=True)
         rounds = list(self.ring)
         trace_events = [ev for entry in rounds for ev in entry["spans"]]
+        # solver-interior stall events (structured reasons + the final
+        # K supersteps of telemetry) ride along in every dump: a NOOP
+        # round's post-mortem needs to show WHY the ladder exhausted,
+        # not just that it did
+        from .soltel import recent_stalls
+
         payload = {
             "reason": reason,
             "captured_at": time.time(),
             "rounds_seen": self.rounds_seen,
             "rounds": rounds,
             "traceEvents": trace_events,
+            "solver_stalls": recent_stalls(),
             "displayTimeUnit": "ms",
         }
         with open(path, "w") as f:

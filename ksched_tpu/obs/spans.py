@@ -164,18 +164,16 @@ class SpanTracer:
 
     # -- recording ---------------------------------------------------------
 
-    def _record(self, sp: Span) -> None:
-        args = dict(sp.args) if sp.args else {}
-        args["sid"] = sp.sid
-        if sp.parent_sid:
-            args["parent_sid"] = sp.parent_sid
-            args["parent"] = sp.parent_name
+    def _append(self, name: str, t0_s: float, dur_s: float, args: Dict) -> None:
+        """One Chrome-event construction + locked ring append for both
+        recorded spans and synthesized events — the schema must never
+        fork between the two."""
         event = {
             "ph": "X",
             "cat": "ksched",
-            "name": sp.name,
-            "ts": sp.t0_s * 1e6,  # perf_counter base: monotonic, shared in-process
-            "dur": (sp.t1_s - sp.t0_s) * 1e6,
+            "name": name,
+            "ts": t0_s * 1e6,  # perf_counter base: monotonic, shared in-process
+            "dur": dur_s * 1e6,
             "pid": os.getpid(),
             "tid": threading.get_ident(),
             "args": args,
@@ -185,6 +183,23 @@ class SpanTracer:
                 self.dropped += 1
             self._events.append(event)
             self.total += 1
+
+    def _record(self, sp: Span) -> None:
+        args = dict(sp.args) if sp.args else {}
+        args["sid"] = sp.sid
+        if sp.parent_sid:
+            args["parent_sid"] = sp.parent_sid
+            args["parent"] = sp.parent_name
+        self._append(sp.name, sp.t0_s, sp.t1_s - sp.t0_s, args)
+
+    def record_event(self, name: str, t0_s: float, dur_s: float, args: Optional[Dict] = None) -> None:
+        """Record a SYNTHESIZED complete event (no live Span object):
+        the solver-interior telemetry decode (obs/soltel.py) fabricates
+        per-superstep child spans under a backend_solve span from
+        device counters, apportioning the parent's wall time — the
+        device cannot produce host timestamps itself. Events land in
+        the same ring with the same schema as recorded spans."""
+        self._append(name, t0_s, dur_s, dict(args) if args else {})
 
     # -- slicing (flight recorder) -----------------------------------------
 

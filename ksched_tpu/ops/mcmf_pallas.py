@@ -83,18 +83,35 @@ def mega_fits_vmem(
     num_entries: int,
     lanes: int = MEGA_LANES,
     budget_bytes: int = _MEGA_VMEM_BUDGET_BYTES,
+    telemetry: bool = False,
 ) -> bool:
-    """Whether the whole-solve live set stays VMEM-resident."""
+    """Whether the whole-solve live set stays VMEM-resident. With
+    solver telemetry on, the budget charges one extra tile: the
+    telemetry ring is clamped to at most one [R, L] tile of int32
+    (`mega_telemetry_cap`), so +1 tile is exact, not an estimate."""
     padded = mega_entry_rows(num_entries, lanes) * lanes
-    return _MEGA_LIVE_TILES * padded * 4 <= budget_bytes
+    tiles = _MEGA_LIVE_TILES + (1 if telemetry else 0)
+    return tiles * padded * 4 <= budget_bytes
+
+
+def mega_telemetry_cap(R: int, L: int, cap: int) -> int:
+    """Clamp a telemetry ring capacity so the [cap, SOLTEL_WIDTH]
+    buffer never exceeds one [R, L] entry tile of VMEM — the +1-tile
+    budget `mega_fits_vmem(telemetry=True)` charges. Small graphs get
+    a shorter ring (their solves are short too); the ring keeps the
+    FINAL supersteps either way."""
+    from ..obs.soltel import SOLTEL_WIDTH
+
+    return max(1, min(int(cap), (R * L) // SOLTEL_WIDTH))
 
 
 def _mcmf_kernel(
     sign_ref, cap_ref, sc_ref, sup_ref, hs_ref, he_ref,
     prow_ref, pcol_ref, f0_ref, eps_ref,
     fout_ref, steps_ref, conv_ref, povf_ref,
-    *, R: int, L: int, alpha: int, max_supersteps: int,
-    tighten_sweeps: int,
+    *tel_refs,
+    R: int, L: int, alpha: int, max_supersteps: int,
+    tighten_sweeps: int, telemetry_cap: int = 0,
 ):
     i32 = jnp.int32
     sign = sign_ref[:]       # [R, L] +1 fwd / -1 bwd / 0 pad
@@ -240,26 +257,64 @@ def _mcmf_kernel(
         best = seg_max(cand)
         relabel = (exc > 0) & (pushed == 0) & (sum_r > 0)
         new_p = jnp.where(relabel, best - eps, p)
-        return new_f, new_p
+        if not telemetry_cap:
+            return new_f, new_p, ()
+        # soltel counters (cols 3..6) from state this superstep already
+        # holds in VMEM — pure reductions and masks, no new gathers, so
+        # the kernel's MEGA_KERNEL_PERM_GATHERS budget is unchanged.
+        # Per-node quantities (relabels) are counted at segment heads;
+        # delta counts each pushed unit once (per-entry amounts).
+        aux = (
+            jnp.sum(delta),
+            jnp.sum(jnp.where((hs == 1) & relabel, i32(1), i32(0))),
+            jnp.sum(jnp.where((sign > 0) & (r == 0), i32(1), i32(0))),
+            jnp.sum(adm.astype(i32)),
+        )
+        return new_f, new_p, aux
+
+    if telemetry_cap:
+        tel_rows_iota = lax.broadcasted_iota(i32, (telemetry_cap, 1), 0)
+        tel_cols_iota = lax.broadcasted_iota(i32, (1, 8), 1)
+
+    def tel_update(tel, steps, eps, exc, aux):
+        """Write one soltel row at steps % cap — a masked elementwise
+        select over the [cap, 8] ring (dynamic-index stores don't
+        lower on Pallas TPU; this does, and the ring is small)."""
+        pushed_n, relabels, saturated, work = aux
+        active = jnp.sum(jnp.where((hs == 1) & (exc > 0), i32(1), i32(0)))
+        exc_pos = jnp.sum(jnp.where(hs == 1, jnp.maximum(exc, 0), i32(0)))
+        vals = (eps, active, exc_pos, pushed_n, relabels, saturated, work)
+        row = i32(0)
+        for j, v in enumerate(vals):
+            row = jnp.where(tel_cols_iota == j, v, row)
+        idx = jnp.remainder(steps, i32(telemetry_cap))
+        return jnp.where(tel_rows_iota == idx, row, tel)
 
     def phase_cond(state):
-        *_rest, steps, done = state
+        steps, done = state[3], state[4]
         return ~done & (steps < max_supersteps)
 
     def phase_body(state):
-        f, p, eps, steps, done = state
+        if telemetry_cap:
+            f, p, eps, steps, done, tel = state
+        else:
+            f, p, eps, steps, done = state
         exc = excess_of(f)
         any_active = jnp.any(exc > 0)
 
         def do_step(_):
-            f2, p2 = superstep(f, p, eps, exc)
-            return f2, p2, eps, steps + 1, jnp.bool_(False)
+            f2, p2, aux = superstep(f, p, eps, exc)
+            if not telemetry_cap:
+                return f2, p2, eps, steps + 1, jnp.bool_(False)
+            tel2 = tel_update(tel, steps, eps, exc, aux)
+            return f2, p2, eps, steps + 1, jnp.bool_(False), tel2
 
         def next_phase(_):
             finished = eps <= 1
             new_eps = jnp.maximum(i32(1), eps // alpha)
             f2 = jnp.where(finished, f, saturate(f, p))
-            return f2, p, jnp.where(finished, eps, new_eps), steps, finished
+            out = (f2, p, jnp.where(finished, eps, new_eps), steps, finished)
+            return out + ((tel,) if telemetry_cap else ())
 
         return lax.cond(any_active, do_step, next_phase, operand=None)
 
@@ -267,7 +322,14 @@ def _mcmf_kernel(
     p0 = tighten(f0)
     f1 = saturate(f0, p0)  # mop up any residual violations
     state = (f1, p0, eps0, i32(0), jnp.bool_(False))
-    f, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
+    if telemetry_cap:
+        state = state + (jnp.zeros((telemetry_cap, 8), i32),)
+        f, p, eps, steps, done, tel = lax.while_loop(
+            phase_cond, phase_body, state
+        )
+        tel_refs[0][:] = tel
+    else:
+        f, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
     exc = excess_of(f)
     fout_ref[:] = f
     steps_ref[0] = steps
@@ -278,7 +340,8 @@ def _mcmf_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "R", "L", "alpha", "max_supersteps", "tighten_sweeps", "interpret"
+        "R", "L", "alpha", "max_supersteps", "tighten_sweeps", "interpret",
+        "telemetry_cap",
     ),
 )
 def mcmf_loop_pallas(
@@ -289,6 +352,7 @@ def mcmf_loop_pallas(
     max_supersteps: int = 50_000,
     tighten_sweeps: int = 32,
     interpret: bool = False,
+    telemetry_cap: int = 0,
 ):
     """One fused kernel per general-graph MCMF solve.
 
@@ -298,11 +362,16 @@ def mcmf_loop_pallas(
     from the cached `build_csr_plan` ordering; fwd_pos: int32[M] flat
     position of each arc's forward entry. Returns
     (flow[M], steps, converged, p_overflow) matching `_solve_mcmf`'s
-    public result bit-for-bit. The per-solve entry materialization
-    (cap/cost/supply/flow gathered to entry order) runs as plain XLA
-    ONCE per solve — the kernel itself never touches HBM between
-    supersteps."""
+    public result bit-for-bit (+ the [telemetry_cap, 8] soltel ring
+    when telemetry_cap > 0 — written from inside the pallas_call to a
+    dedicated VMEM output, clamped by `mega_telemetry_cap` to one
+    entry tile so the VMEM budget grows by exactly +1 tile). The
+    per-solve entry materialization (cap/cost/supply/flow gathered to
+    entry order) runs as plain XLA ONCE per solve — the kernel itself
+    never touches HBM between supersteps."""
     i32 = jnp.int32
+    if telemetry_cap:
+        telemetry_cap = mega_telemetry_cap(R, L, telemetry_cap)
     live = e_sign != 0
     arc = jnp.clip(e_arc, 0, cap.shape[0] - 1)
     src = jnp.clip(e_src, 0, supply.shape[0] - 1)
@@ -312,18 +381,28 @@ def mcmf_loop_pallas(
     sup2 = jnp.where(live, supply[src], 0).astype(i32).reshape(R, L)
     f02 = jnp.where(live, flow0[arc], 0).astype(i32).reshape(R, L)
 
-    f_out, steps, conv, povf = pl.pallas_call(
+    out_shape = [
+        jax.ShapeDtypeStruct((R, L), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+    ]
+    out_specs = [
+        pl.BlockSpec(memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    if telemetry_cap:
+        out_shape.append(jax.ShapeDtypeStruct((telemetry_cap, 8), jnp.int32))
+        out_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
+    outs = pl.pallas_call(
         functools.partial(
             _mcmf_kernel,
             R=R, L=L, alpha=alpha, max_supersteps=max_supersteps,
-            tighten_sweeps=tighten_sweeps,
+            tighten_sweeps=tighten_sweeps, telemetry_cap=telemetry_cap,
         ),
-        out_shape=[
-            jax.ShapeDtypeStruct((R, L), jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
-        ],
+        out_shape=out_shape,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -336,12 +415,7 @@ def mcmf_loop_pallas(
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        out_specs=out_specs,
         interpret=interpret,
     )(
         sign2,
@@ -355,5 +429,9 @@ def mcmf_loop_pallas(
         f02,
         eps_init.astype(i32).reshape(1),
     )
+    f_out, steps, conv, povf = outs[:4]
     flow = f_out.reshape(-1)[fwd_pos]
-    return flow, steps[0], conv[0] != 0, povf[0] != 0
+    base = (flow, steps[0], conv[0] != 0, povf[0] != 0)
+    if telemetry_cap:
+        return base + (outs[4],)
+    return base

@@ -168,11 +168,15 @@ def _seg_scan(vals, isstart, combine_val):
     return scanned
 
 
-def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, tighten_sweeps: int = 32):
+def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, tighten_sweeps: int = 32, telemetry_cap: int = 0):
     """Build the jitted sharded solve fn over the given mesh axis. The
     per-shard plan arrays arrive as call arguments (sharded on their
     leading axis); nothing is baked into the compiled function besides
-    shapes."""
+    shapes. telemetry_cap > 0 appends the replicated soltel ring
+    (obs/soltel.py) to the outputs: per-shard counter contributions are
+    psum-combined, so the rows are GLOBAL — identical on every shard —
+    and cap=0 traces the exact pre-telemetry program."""
+    from ..obs.soltel import SOLTEL_WIDTH
     from ._compat import SHARD_MAP_KWARGS as shard_map_kwargs, shard_map
 
     spec_sharded = P(axis)
@@ -258,7 +262,20 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
             relabel = (excess > 0) & (pushed == 0) & (sum_r > 0) & owned
             p_local = jnp.where(relabel, best - eps, jnp.where(owned, p, i32(0)))
             new_p = lax.psum(jnp.where(owned, p_local, i32(0)), axis)
-            return new_flow, new_p
+            if not telemetry_cap:
+                return new_flow, new_p, ()
+            # soltel cols 3..6: per-shard contributions psum'd to global
+            # counts (each entry/owned node contributes on one shard)
+            aux = (
+                lax.psum(jnp.sum(jnp.where(s_valid, delta, i32(0))), axis),
+                lax.psum(jnp.sum(relabel.astype(i32)), axis),
+                lax.psum(
+                    jnp.sum(((s_sign > 0) & s_valid & (r == 0)).astype(i32)),
+                    axis,
+                ),
+                lax.psum(jnp.sum(admissible.astype(i32)), axis),
+            )
+            return new_flow, new_p, aux
 
         def sat_full(flow, p):
             rc = s_cost + p[s_src] - p[s_dst]
@@ -272,34 +289,74 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
             tgt = jnp.maximum(lax.pmax(tgt_f, axis), lax.pmax(tgt_b, axis))
             return jnp.where(tgt >= 0, tgt, flow)
 
+        if telemetry_cap:
+            from ..obs import soltel as _soltel
+
+            _tel_rows_iota = _soltel.device_rows_iota(telemetry_cap)
+
+        def tel_row(eps, excess, aux):
+            # excess is already the psum-combined global [N] vector,
+            # identical on every shard — no further combine needed
+            return _soltel.device_row(
+                eps,
+                jnp.sum((excess > 0).astype(i32)),
+                jnp.sum(jnp.maximum(excess, 0)),
+                *aux,
+            )
+
+        def tel_write(tel, steps, row):
+            return _soltel.device_ring_write(
+                tel, steps, row, telemetry_cap, _tel_rows_iota
+            )
+
         def phase_cond(state):
-            _flow, _p, _eps, steps, done = state
+            steps, done = state[3], state[4]
             return ~done & (steps < step_cap)
 
         def phase_body(state):
-            flow, p, eps, steps, done = state
+            if telemetry_cap:
+                flow, p, eps, steps, done, tel = state
+            else:
+                flow, p, eps, steps, done = state
             excess = excess_of(flow)
             any_active = jnp.any(excess > 0)
 
             def do_superstep(_):
-                f2, p2 = superstep(flow, p, eps, excess)
-                return f2, p2, eps, steps + 1, jnp.bool_(False)
+                f2, p2, aux = superstep(flow, p, eps, excess)
+                if not telemetry_cap:
+                    return f2, p2, eps, steps + 1, jnp.bool_(False)
+                tel2 = tel_write(tel, steps, tel_row(eps, excess, aux))
+                return f2, p2, eps, steps + 1, jnp.bool_(False), tel2
 
             def next_phase(_):
                 finished = eps <= 1
                 new_eps = jnp.maximum(i32(1), eps // alpha)
                 f2 = jnp.where(finished, flow, sat_full(flow, p))
-                return f2, p, jnp.where(finished, eps, new_eps), steps, finished
+                out = (
+                    f2, p, jnp.where(finished, eps, new_eps), steps, finished
+                )
+                return out + ((tel,) if telemetry_cap else ())
 
             return lax.cond(any_active, do_superstep, next_phase, operand=None)
 
         p0 = tighten(flow0)
         flow1 = sat_full(flow0, p0)
         state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
-        flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
+        if telemetry_cap:
+            state = state + (jnp.zeros((telemetry_cap, SOLTEL_WIDTH), i32),)
+            flow, p, eps, steps, done, tel = lax.while_loop(
+                phase_cond, phase_body, state
+            )
+        else:
+            flow, p, eps, steps, done = lax.while_loop(
+                phase_cond, phase_body, state
+            )
         converged = done & (jnp.max(jnp.abs(excess_of(flow))) == 0)
         p_overflow = jnp.max(jnp.abs(p)) >= (1 << 30)
-        return flow, steps, converged, p_overflow
+        base = (flow, steps, converged, p_overflow)
+        if telemetry_cap:
+            return base + (tel,)
+        return base
 
     in_specs = (
         spec_repl, spec_repl, spec_repl, spec_repl, spec_repl, spec_repl,
@@ -308,6 +365,8 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
         spec_sharded, spec_sharded, spec_sharded,
     )
     out_specs = (spec_repl, spec_repl, spec_repl, spec_repl)
+    if telemetry_cap:
+        out_specs = out_specs + (spec_repl,)
     fn = shard_map(
         solve_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **shard_map_kwargs,
@@ -318,17 +377,20 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
 class ShardedJaxSolver(FlowSolver):
     """Push-relabel MCMF sharded over a jax Mesh axis."""
 
-    def __init__(self, mesh: Mesh, axis: str = "x", alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True):
+    def __init__(self, mesh: Mesh, axis: str = "x", alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True, telemetry: Optional[int] = None):
         self.mesh = mesh
         self.axis = axis
         self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
+        self.telemetry = telemetry
         self._plan: Optional[ShardedPlan] = None
         self._plan_dev = None
         self._solve_fn = None
+        self._solve_fn_cap = 0  # telemetry_cap the cached fn was built for
         self._prev: Optional[np.ndarray] = None
         self.last_supersteps = 0
+        self.last_telemetry = None
 
     def reset(self) -> None:
         self._prev = None
@@ -338,11 +400,14 @@ class ShardedJaxSolver(FlowSolver):
         return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names if a == self.axis]))
 
     def solve(self, problem: FlowProblem) -> FlowResult:
+        from ..obs import soltel
+
         n = problem.num_nodes
         m = len(problem.src)
         if m == 0 or problem.num_arcs == 0:
             if (problem.excess > 0).any():
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
+            self.last_telemetry = None
             return FlowResult(flow=np.zeros(m, dtype=np.int64), objective=0, iterations=0)  # kschedlint: host-only (FlowResult contract is int64)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
@@ -353,6 +418,7 @@ class ShardedJaxSolver(FlowSolver):
             raise OverflowError("scaled costs overflow int32")
         cost = problem.cost.astype(np.int32) * np.int32(n)
 
+        tel_cap = soltel.resolve_cap(self.telemetry)
         prev_plan = self._plan
         plan = prev_plan
         if plan is None or len(plan.src) != m or plan.node_first.shape[1] != n or not (
@@ -369,9 +435,13 @@ class ShardedJaxSolver(FlowSolver):
                     plan.owned, plan.pos_fwd, plan.pos_bwd,
                 )
             )
+            self._solve_fn = None
+        if self._solve_fn is None or self._solve_fn_cap != tel_cap:
             self._solve_fn = make_sharded_solver(
-                self.mesh, self.axis, self.alpha, self.max_supersteps
+                self.mesh, self.axis, self.alpha, self.max_supersteps,
+                telemetry_cap=tel_cap,
             )
+            self._solve_fn_cap = tel_cap
 
         flow0 = np.zeros(m, dtype=np.int32)
         if (
@@ -391,23 +461,44 @@ class ShardedJaxSolver(FlowSolver):
             (np.zeros(m, dtype=np.int32), max(1, max_cost * n), self.max_supersteps),
         ]
         flow = steps = None
+        tel_buf = None
+        budget = self.max_supersteps
         converged = p_overflow = False
         for f0, eps_init, cap_steps in attempts:
-            flow, steps, converged, p_overflow = self._solve_fn(
+            out = self._solve_fn(
                 jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply),
                 jnp.asarray(f0), jnp.asarray(np.int32(eps_init)),
                 jnp.asarray(np.int32(cap_steps)),
                 *self._plan_dev,
             )
+            if tel_cap:
+                flow, steps, converged, p_overflow, tel_buf = out
+            else:
+                flow, steps, converged, p_overflow = out
+            budget = cap_steps
             if bool(converged) and not bool(p_overflow):
                 break
         self.last_supersteps = int(steps)
+        self.last_telemetry = (
+            soltel.decode(
+                tel_buf, int(steps), tel_cap, "sharded", budget,
+                converged=bool(converged) and not bool(p_overflow),
+                nodes=n, arcs=m,
+            )
+            if tel_buf is not None
+            else None
+        )
         if bool(p_overflow) or not bool(converged):
             self._prev = None
         if bool(p_overflow):
             raise OverflowError("sharded push-relabel potentials approached int32 range")
         if not bool(converged):
-            raise RuntimeError("sharded push-relabel did not converge; infeasible?")
+            tel = self.last_telemetry
+            raise soltel.SolverStallError(
+                "sharded push-relabel did not converge; infeasible?",
+                reason=soltel.detect_stall(tel) if tel is not None else None,
+                telemetry=tel,
+            )
         flow_np = np.asarray(flow)
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)

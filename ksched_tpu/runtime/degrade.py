@@ -34,10 +34,21 @@ DEGRADABLE_ERRORS = (RuntimeError, ValueError, ArithmeticError)
 
 
 class LadderExhausted(RuntimeError):
-    """Every rung of the degradation ladder failed this round."""
+    """Every rung of the degradation ladder failed this round.
 
-    def __init__(self, failures: List[Tuple[str, BaseException]]) -> None:
+    `reasons` carries one STRUCTURED reason per failed rung
+    (obs/soltel.failure_reason): the stall detector's verdict with the
+    final supersteps of telemetry when the failure was a genuine
+    non-convergence, a classified error otherwise — what the flight
+    recorder dumps instead of a bare timeout string."""
+
+    def __init__(
+        self,
+        failures: List[Tuple[str, BaseException]],
+        reasons: Optional[List[dict]] = None,
+    ) -> None:
         self.failures = failures
+        self.reasons = reasons or []
         detail = "; ".join(f"{name}: {err}" for name, err in failures)
         super().__init__(f"all solver rungs failed: {detail}")
 
@@ -66,6 +77,9 @@ class DegradingSolver(FlowSolver):
         self.last_degradations = 0
         self.last_rung = -1
         self.last_rung_name: Optional[str] = None
+        #: structured reasons (obs/soltel.failure_reason) for the rungs
+        #: that failed during the LAST solve, in failure order
+        self.last_failure_reasons: List[dict] = []
         # obs handles resolve at construction time (scoped_registry works)
         reg = get_registry()
         self._m_degradations = reg.counter(
@@ -105,9 +119,12 @@ class DegradingSolver(FlowSolver):
     # -- FlowSolver --------------------------------------------------------
 
     def solve(self, problem: FlowProblem) -> FlowResult:
+        from ..obs import soltel
+
         self.last_degradations = 0
         self.last_rung = -1
         self.last_rung_name = None
+        self.last_failure_reasons = []
         failures: List[Tuple[str, BaseException]] = []
         for i, (name, _) in enumerate(self._rungs):
             p = problem
@@ -124,12 +141,21 @@ class DegradingSolver(FlowSolver):
                 result = self._backend(i).solve_traced(p)
             except DEGRADABLE_ERRORS as e:
                 failures.append((name, e))
+                # structured reason instead of a bare timeout: the stall
+                # detector's verdict (+ the final supersteps of
+                # telemetry) lands in the soltel ring that every flight
+                # dump embeds, and rides LadderExhausted.reasons
+                reason = soltel.failure_reason(name, e)
+                self.last_failure_reasons.append(
+                    soltel.note_stall(reason, getattr(e, "telemetry", None))
+                )
                 self.degradations_total += 1
                 self.last_degradations += 1
                 self._m_degradations.labels(rung=name).inc()
                 nxt = self._rungs[i + 1][0] if i + 1 < len(self._rungs) else None
                 warnings.warn(
-                    f"solver rung {name!r} failed ({e}); "
+                    f"solver rung {name!r} failed "
+                    f"({reason.get('kind', 'error')}: {e}); "
                     + (f"degrading to {nxt!r}" if nxt else "ladder exhausted"),
                     RuntimeWarning,
                     stacklevel=2,
@@ -140,7 +166,7 @@ class DegradingSolver(FlowSolver):
             self._m_rung.set(i)
             return result
         self._m_exhausted.inc()
-        raise LadderExhausted(failures)
+        raise LadderExhausted(failures, reasons=list(self.last_failure_reasons))
 
     def reset(self) -> None:
         # only instantiated rungs carry warm state worth dropping

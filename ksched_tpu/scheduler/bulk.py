@@ -479,6 +479,15 @@ class BulkCluster:
 
         with span("solve", path="layered") as sp:
             res = self.backend.solve_layered(lp)
+            # solver-interior telemetry (obs/soltel.py): the layered
+            # backend is dispatched here, not through solve_traced, so
+            # this is its publication seam — registry histograms +
+            # per-superstep child spans under this solve span
+            tel = getattr(self.backend, "last_telemetry", None)
+            if tel is not None:
+                from ..obs import soltel
+
+                soltel.publish(tel, sp)
         timing["solve_s"] = sp.dur_s
 
         sp = span("decode").__enter__()

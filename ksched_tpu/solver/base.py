@@ -75,7 +75,16 @@ class FlowSolver(abc.ABC):
         driver and the degradation ladder call it, so each rung attempt
         (including a failing one, whose span records the error) is a
         nested span in a captured trace. Costs two ``perf_counter``
-        reads when no tracer is installed."""
+        reads when no tracer is installed.
+
+        Backends that emit solver-interior telemetry (``last_telemetry``
+        after a solve — the compiled jax/ell/mega/layered/sharded
+        loops) additionally get their buffer decoded here: superstep
+        histograms onto the registry, per-superstep child spans under
+        this span (Perfetto shows the convergence shape), and the
+        stall detector (obs/soltel.py). ``native``/``cpu_ref`` expose
+        no interior telemetry and skip all of it."""
+        from ..obs import soltel
         from ..obs.spans import span
 
         with span(
@@ -92,6 +101,9 @@ class FlowSolver(abc.ABC):
             )
             if work:
                 sp.set("supersteps", work)
+            tel = getattr(self, "last_telemetry", None)
+            if tel is not None:
+                soltel.publish(tel, sp)
         return result
 
     def reset(self) -> None:

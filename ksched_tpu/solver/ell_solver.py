@@ -201,7 +201,7 @@ def _g2(table, idx2):
     return table[idx2]
 
 @functools.partial(
-    jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps")
+    jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap")
 )
 def _solve_mcmf_ell(
     cap, cost, supply, flow0, eps_init,
@@ -212,7 +212,14 @@ def _solve_mcmf_ell(
     alpha: int = 8,
     max_supersteps: int = 50_000,
     tighten_sweeps: int = 32,
+    telemetry_cap: int = 0,
 ):
+    """telemetry_cap > 0 appends the superstep-indexed telemetry ring
+    (obs/soltel.py layout) to the returned tuple — same contract as
+    solver/jax_solver.py `_solve_mcmf`; cap=0 traces the exact
+    pre-telemetry jaxpr."""
+    from ..obs.soltel import SOLTEL_WIDTH
+
     i32 = jnp.int32
     kmax = hub_rows.shape[1]
 
@@ -325,35 +332,78 @@ def _solve_mcmf_ell(
         )
         relabel = (excess > 0) & (pushed == 0) & (sum_r > 0)
         new_p = jnp.where(relabel, best - eps, p)
-        return new_flow, new_p
+        if not telemetry_cap:
+            return new_flow, new_p, ()
+        aux = (
+            jnp.sum(pushed),
+            jnp.sum(relabel.astype(i32)),
+            # flow == cap <=> forward residual 0 (zero-cap arcs count:
+            # their residual is zero) — matches the CSR/mega counters
+            jnp.sum((flow >= cap).astype(i32)),
+            jnp.sum(adm_s.astype(i32)) + jnp.sum(adm_h.astype(i32)),
+        )
+        return new_flow, new_p, aux
+
+    if telemetry_cap:
+        from ..obs import soltel as _soltel
+
+        _tel_rows_iota = _soltel.device_rows_iota(telemetry_cap)
+
+    def tel_row(eps, excess, aux):
+        return _soltel.device_row(
+            eps,
+            jnp.sum((excess > 0).astype(i32)),
+            jnp.sum(jnp.maximum(excess, 0)),
+            *aux,
+        )
+
+    def tel_write(tel, steps, row):
+        return _soltel.device_ring_write(
+            tel, steps, row, telemetry_cap, _tel_rows_iota
+        )
 
     def phase_cond(state):
-        _flow, _p, _eps, steps, done = state
+        steps, done = state[3], state[4]
         return ~done & (steps < max_supersteps)
 
     def phase_body(state):
-        flow, p, eps, steps, done = state
+        if telemetry_cap:
+            flow, p, eps, steps, done, tel = state
+        else:
+            flow, p, eps, steps, done = state
         excess = excess_of(flow)
         any_active = jnp.any(excess > 0)
 
         def do_superstep(_):
-            f2, p2 = superstep(flow, p, eps, excess)
-            return f2, p2, eps, steps + 1, jnp.bool_(False)
+            f2, p2, aux = superstep(flow, p, eps, excess)
+            if not telemetry_cap:
+                return f2, p2, eps, steps + 1, jnp.bool_(False)
+            tel2 = tel_write(tel, steps, tel_row(eps, excess, aux))
+            return f2, p2, eps, steps + 1, jnp.bool_(False), tel2
 
         def next_phase(_):
             finished = eps <= 1
             new_eps = jnp.maximum(i32(1), eps // alpha)
             f2 = jnp.where(finished, flow, saturate(flow, p))
-            return f2, p, jnp.where(finished, eps, new_eps), steps, finished
+            out = (f2, p, jnp.where(finished, eps, new_eps), steps, finished)
+            return out + ((tel,) if telemetry_cap else ())
 
         return lax.cond(any_active, do_superstep, next_phase, operand=None)
 
     p0 = tighten(flow0)
     flow1 = saturate(flow0, p0)
     state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
-    flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
+    if telemetry_cap:
+        state = state + (jnp.zeros((telemetry_cap, SOLTEL_WIDTH), i32),)
+        flow, p, eps, steps, done, tel = lax.while_loop(
+            phase_cond, phase_body, state
+        )
+    else:
+        flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
     converged = done & (jnp.max(jnp.abs(excess_of(flow))) == 0)
     p_overflow = jnp.max(jnp.abs(p)) >= _P_GUARD
+    if telemetry_cap:
+        return flow, p, steps, converged, p_overflow, tel
     return flow, p, steps, converged, p_overflow
 
 
@@ -379,6 +429,7 @@ class EllSolver(FlowSolver):
     def __init__(
         self, alpha: int = 8, max_supersteps: int = 50_000,
         warm_start: bool = True, w_small: int = 8, w_hub: int = 512,
+        telemetry: Optional[int] = None,
     ):
         from .layered import validate_alpha
 
@@ -387,10 +438,12 @@ class EllSolver(FlowSolver):
         self.warm_start = warm_start
         self.w_small = w_small
         self.w_hub = w_hub
+        self.telemetry = telemetry
         self._prev: Optional[np.ndarray] = None
         self._plan: Optional[EllPlan] = None
         self._plan_dev: Optional[tuple] = None
         self.last_supersteps = 0
+        self.last_telemetry = None
 
     def reset(self) -> None:
         self._prev = None
@@ -438,6 +491,9 @@ class EllSolver(FlowSolver):
                 same = (prev_plan.src == src) & (prev_plan.dst == dst)
                 flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
 
+        from ..obs import soltel
+
+        tel_cap = soltel.resolve_cap(self.telemetry)
         dev_args = (jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply))
         fut = _solve_mcmf_ell(
             *dev_args,
@@ -446,37 +502,64 @@ class EllSolver(FlowSolver):
             *plan_dev,
             alpha=self.alpha,
             max_supersteps=min(4096, self.max_supersteps),
+            telemetry_cap=tel_cap,
         )
         cold = (np.zeros(m, dtype=np.int32), max(1, max_cost * n))
-        return (problem, fut, (dev_args, plan_dev, cold), None)
+        return (problem, fut, (dev_args, plan_dev, cold, tel_cap), None)
 
     def complete(self, pending) -> FlowResult:
+        from ..obs import soltel
+
         problem, fut, rest, _ = pending
         if fut is None:
+            self.last_telemetry = None
             return FlowResult(
                 flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
-        flow, p, steps, converged, p_overflow = fut
+        dev_args, plan_dev, (f0_cold, eps_cold), tel_cap = rest
+        tel_buf = None
+        if tel_cap:
+            flow, p, steps, converged, p_overflow, tel_buf = fut
+        else:
+            flow, p, steps, converged, p_overflow = fut
         if not (bool(converged) and not bool(p_overflow)):
-            dev_args, plan_dev, (f0_cold, eps_cold) = rest
-            flow, p, steps, converged, p_overflow = _solve_mcmf_ell(
+            out = _solve_mcmf_ell(
                 *dev_args,
                 jnp.asarray(f0_cold),
                 jnp.asarray(np.int32(eps_cold)),
                 *plan_dev,
                 alpha=self.alpha,
                 max_supersteps=self.max_supersteps,
+                telemetry_cap=tel_cap,
             )
+            if tel_cap:
+                flow, p, steps, converged, p_overflow, tel_buf = out
+            else:
+                flow, p, steps, converged, p_overflow = out
         self.last_supersteps = int(steps)
+        # budget = the SOLVER's budget, not the warm attempt's 4096 cap
+        # (see jax_solver.complete)
+        self.last_telemetry = (
+            soltel.decode(
+                tel_buf, int(steps), tel_cap, "ell", self.max_supersteps,
+                converged=bool(converged) and not bool(p_overflow),
+                nodes=problem.num_nodes, arcs=len(problem.src),
+            )
+            if tel_buf is not None
+            else None
+        )
         if bool(p_overflow) or not bool(converged):
             self._prev = None
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
-            raise RuntimeError(
+            tel = self.last_telemetry
+            raise soltel.SolverStallError(
                 f"push-relabel did not converge within {self.max_supersteps} "
-                "supersteps; the flow problem may be infeasible"
+                "supersteps; the flow problem may be infeasible",
+                reason=soltel.detect_stall(tel) if tel is not None else None,
+                telemetry=tel,
             )
         flow_np = np.asarray(flow)
         if self.warm_start:

@@ -152,7 +152,7 @@ def _seg_min(vals, isstart, node_last, node_nonempty, identity):
 _BIG_D = 1 << 28  # "unreachable" distance sentinel for price tightening
 
 
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps"))
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap"))
 def _solve_mcmf(
     cap, cost, supply, flow0, eps_init,
     s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, inv_order,
@@ -160,7 +160,17 @@ def _solve_mcmf(
     alpha: int = 8,
     max_supersteps: int = 50_000,
     tighten_sweeps: int = 32,
+    telemetry_cap: int = 0,
 ):
+    """telemetry_cap > 0 appends a superstep-indexed int32 telemetry
+    ring [telemetry_cap, SOLTEL_WIDTH] to the returned tuple (row
+    layout: obs/soltel.py), written at `step % cap` so the final
+    supersteps always survive. The counters read state each superstep
+    already computes — flows are bit-identical on/off, and with cap=0
+    this traces the exact pre-telemetry jaxpr (no cost when off;
+    pinned by the jaxpr contracts)."""
+    from ..obs.soltel import SOLTEL_WIDTH
+
     m = cap.shape[0]
     i32 = jnp.int32
 
@@ -238,51 +248,107 @@ def _solve_mcmf(
         best = _seg_max(cand, s_isstart, node_last, node_nonempty, -_BIG)
         relabel = (excess > 0) & (pushed == 0) & (sum_r > 0)
         new_p = jnp.where(relabel, best - eps, p)
-        return new_flow, new_p
+        if not telemetry_cap:
+            return new_flow, new_p, ()
+        # counters over state this superstep already computed (soltel
+        # row cols 3..6); purely observational, never fed back — and
+        # appended AFTER the original dataflow so the telemetry-off
+        # trace keeps the exact pre-telemetry op order (pinned hash).
+        # Cost discipline: `pushed` is the already-reduced [N] per-node
+        # push total (sum == sum(delta) since segments partition the
+        # entries), and the saturated mask reuses r/s_sign — the only
+        # NEW entry-space passes are two compare+sum sweeps, no
+        # gathers (a zero-capacity arc counts as saturated: its
+        # residual is zero, which is what the counter means).
+        aux = (
+            jnp.sum(pushed),
+            jnp.sum(relabel.astype(i32)),
+            jnp.sum(((s_sign > 0) & (r == 0)).astype(i32)),
+            # r_adm > 0 <=> admissible (admissibility requires r > 0),
+            # and r_adm is already materialized for the prefix cumsum
+            jnp.sum((r_adm > 0).astype(i32)),
+        )
+        return new_flow, new_p, aux
+
+    if telemetry_cap:
+        from ..obs import soltel as _soltel
+
+        _tel_rows_iota = _soltel.device_rows_iota(telemetry_cap)
+
+    def tel_row(eps, excess, aux):
+        active = jnp.sum((excess > 0).astype(i32))
+        exc_pos = jnp.sum(jnp.maximum(excess, 0))
+        return _soltel.device_row(eps, active, exc_pos, *aux)
+
+    def tel_write(tel, steps, row):
+        return _soltel.device_ring_write(
+            tel, steps, row, telemetry_cap, _tel_rows_iota
+        )
 
     def phase_cond(state):
-        _flow, _p, _eps, steps, done = state
+        done = state[4]
+        steps = state[3]
         return ~done & (steps < max_supersteps)
 
     def phase_body(state):
-        flow, p, eps, steps, done = state
+        if telemetry_cap:
+            flow, p, eps, steps, done, tel = state
+        else:
+            flow, p, eps, steps, done = state
         excess = excess_of(flow)
         any_active = jnp.any(excess > 0)
 
         def do_superstep(_):
-            f2, p2 = superstep(flow, p, eps, excess)
-            return f2, p2, eps, steps + 1, jnp.bool_(False)
+            f2, p2, aux = superstep(flow, p, eps, excess)
+            if not telemetry_cap:
+                return f2, p2, eps, steps + 1, jnp.bool_(False)
+            tel2 = tel_write(tel, steps, tel_row(eps, excess, aux))
+            return f2, p2, eps, steps + 1, jnp.bool_(False), tel2
 
         def next_phase(_):
             finished = eps <= 1
             new_eps = jnp.maximum(i32(1), eps // alpha)
             f2 = jnp.where(finished, flow, saturate(flow, p))
-            return f2, p, jnp.where(finished, eps, new_eps), steps, finished
+            out = (f2, p, jnp.where(finished, eps, new_eps), steps, finished)
+            return out + ((tel,) if telemetry_cap else ())
 
         return lax.cond(any_active, do_superstep, next_phase, operand=None)
 
     p0 = tighten(flow0)
     flow1 = saturate(flow0, p0)  # mop up any residual violations
     state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
-    flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
+    if telemetry_cap:
+        state = state + (jnp.zeros((telemetry_cap, SOLTEL_WIDTH), i32),)
+        flow, p, eps, steps, done, tel = lax.while_loop(
+            phase_cond, phase_body, state
+        )
+    else:
+        flow, p, eps, steps, done = lax.while_loop(phase_cond, phase_body, state)
     converged = done & (jnp.max(jnp.abs(excess_of(flow))) == 0)
     p_overflow = jnp.max(jnp.abs(p)) >= _P_GUARD
+    if telemetry_cap:
+        return flow, p, steps, converged, p_overflow, tel
     return flow, p, steps, converged, p_overflow
 
 
 class JaxSolver(FlowSolver):
     """Cost-scaling push-relabel on device, warm-started across rounds."""
 
-    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True):
+    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True, telemetry: Optional[int] = None):
         from .layered import validate_alpha
 
         self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
+        #: telemetry ring capacity override; None = the soltel module
+        #: default (0 when KSCHED_SOLTEL=0 — telemetry off, identical
+        #: traced program), resolved per solve
+        self.telemetry = telemetry
         self._prev: Optional[np.ndarray] = None  # previous round's flow
         self._plan: Optional[CsrPlan] = None
         self._plan_dev: Optional[tuple] = None
         self.last_supersteps = 0
+        self.last_telemetry = None  # SolveTelemetry of the last solve
 
     def reset(self) -> None:
         self._prev = None
@@ -355,6 +421,9 @@ class JaxSolver(FlowSolver):
         # cost-scaling — so a poisoned warm state can always recover.
         # Only attempt 1 is dispatched here; the cold fallback runs
         # synchronously in complete() if needed (rare).
+        from ..obs import soltel
+
+        tel_cap = soltel.resolve_cap(self.telemetry)
         dev_args = (
             jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply),
         )
@@ -365,38 +434,71 @@ class JaxSolver(FlowSolver):
             *plan_dev,
             alpha=self.alpha,
             max_supersteps=min(4096, self.max_supersteps),
+            telemetry_cap=tel_cap,
         )
         cold = (np.zeros(m, dtype=np.int32), max(1, max_cost * n))
-        return (problem, fut, (dev_args, plan_dev, cold), None)
+        return (problem, fut, (dev_args, plan_dev, cold, tel_cap), None)
 
     def complete(self, pending) -> FlowResult:
         """Synchronize a solve_async dispatch into a FlowResult."""
+        from ..obs import soltel
+
         problem, fut, rest, _ = pending
         if fut is None:
+            self.last_telemetry = None
             return FlowResult(
                 flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
-        flow, p, steps, converged, p_overflow = fut
+        dev_args, plan_dev, (f0_cold, eps_cold), tel_cap = rest
+        tel_buf = None
+        if tel_cap:
+            flow, p, steps, converged, p_overflow, tel_buf = fut
+        else:
+            flow, p, steps, converged, p_overflow = fut
         if not (bool(converged) and not bool(p_overflow)):
-            dev_args, plan_dev, (f0_cold, eps_cold) = rest
-            flow, p, steps, converged, p_overflow = _solve_mcmf(
+            out = _solve_mcmf(
                 *dev_args,
                 jnp.asarray(f0_cold),
                 jnp.asarray(np.int32(eps_cold)),
                 *plan_dev,
                 alpha=self.alpha,
                 max_supersteps=self.max_supersteps,
+                telemetry_cap=tel_cap,
             )
+            if tel_cap:
+                flow, p, steps, converged, p_overflow, tel_buf = out
+            else:
+                flow, p, steps, converged, p_overflow = out
         self.last_supersteps = int(steps)
+        # the telemetry budget is the SOLVER's budget (max_supersteps),
+        # not the warm attempt's internal 4096 cap: a warm solve that
+        # converges near 4096 steps is escalated to the cold fallback,
+        # not failed, so cap-proximity against the warm cap would be a
+        # spurious stall event (and would spam the flight ring)
+        self.last_telemetry = (
+            soltel.decode(
+                tel_buf, int(steps), tel_cap, "jax", self.max_supersteps,
+                converged=bool(converged) and not bool(p_overflow),
+                nodes=problem.num_nodes, arcs=len(problem.src),
+            )
+            if tel_buf is not None
+            else None
+        )
         if bool(p_overflow) or not bool(converged):
             self._prev = None  # never reuse the state that failed
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
-            raise RuntimeError(
+            # non-convergence now carries its interior evidence: the
+            # stall detector's structured reason + the decoded ring
+            # (the degradation ladder forwards both to flight dumps)
+            tel = self.last_telemetry
+            raise soltel.SolverStallError(
                 f"push-relabel did not converge within {self.max_supersteps} supersteps; "
-                "the flow problem may be infeasible (missing unscheduled-aggregator arcs?)"
+                "the flow problem may be infeasible (missing unscheduled-aggregator arcs?)",
+                reason=soltel.detect_stall(tel) if tel is not None else None,
+                telemetry=tel,
             )
         flow_np = np.asarray(flow)
         if self.warm_start:

@@ -308,10 +308,16 @@ def _price_refine(wS, U, col_cap, y, z, pr, pm, psink, eps, waves: int):
     return lax.fori_loop(0, waves, body, (pr, pm, psink))
 
 
-def transport_superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps):
+def transport_superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps,
+                        with_stats: bool = False):
     """One synchronous push/relabel wave over the dense bipartite
     residual graph. A fixed point once no node has positive excess, so
-    it is safe to run under a fixed trip count (lax.fori_loop)."""
+    it is safe to run under a fixed trip count (lax.fori_loop).
+
+    with_stats=True additionally returns the soltel counter tuple
+    (pushed, relabels, saturated, work) computed from this wave's own
+    intermediates (obs/soltel.py cols 3..6) — observational only,
+    never fed back, so flows are bit-identical either way."""
     i32 = jnp.int32
     big = jnp.int32(_BIG)
     e_row, e_col, e_sink = _excesses(supply, y, z)
@@ -373,7 +379,20 @@ def transport_superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps):
     cand_sink = jnp.max(jnp.where(z > 0, pm, -big))
     relabel_sink = (e_sink > 0) & (pushed_sink == 0)
     psink2 = jnp.where(relabel_sink, cand_sink - eps, psink)
-    return y2, z2, pr2, pm2, psink2
+    if not with_stats:
+        return y2, z2, pr2, pm2, psink2
+    stats = (
+        jnp.sum(delta_f) + jnp.sum(deltaA) + jnp.sum(delta_zb),
+        jnp.sum(relabel_row.astype(i32))
+        + jnp.sum(relabel_col.astype(i32))
+        + relabel_sink.astype(i32),
+        jnp.sum(((U > 0) & (y >= U)).astype(i32))
+        + jnp.sum(((col_cap > 0) & (z >= col_cap)).astype(i32)),
+        jnp.sum((r_adm > 0).astype(i32))
+        + jnp.sum((colA > 0).astype(i32))
+        + jnp.sum((zb_adm > 0).astype(i32)),
+    )
+    return y2, z2, pr2, pm2, psink2, stats
 
 
 # ---------------------------------------------------------------------------
@@ -805,7 +824,8 @@ def split_grants_by_class(y_tot, supply):
 
 
 def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
-                    pm_init=None, refine_waves: int = 0):
+                    pm_init=None, refine_waves: int = 0,
+                    telemetry_cap: int = 0):
     """The cost-scaling phase schedule as a bounded lax.while_loop:
     each iteration either runs a superstep (while active nodes exist)
     or advances the eps phase; exits as soon as the eps=1 phase drains
@@ -814,23 +834,64 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
     and inside lax.scan bodies. pm_init optionally warm-starts the
     machine prices (see transport_tighten). Returns
     (y, z, pm, steps, converged) — pm is the final machine-price vector,
-    for carrying into the next round."""
+    for carrying into the next round. telemetry_cap > 0 appends the
+    superstep-indexed soltel ring (obs/soltel.py) to the returned
+    tuple; cap=0 traces the exact pre-telemetry jaxpr."""
+    from ..obs.soltel import SOLTEL_WIDTH
+
     i32 = jnp.int32
 
     def phase_cond(state):
-        *_rest, steps, done = state
+        steps, done = state[6], state[7]
         return ~done & (steps < max_supersteps)
 
+    if telemetry_cap:
+        from ..obs import soltel as _soltel
+
+        _tel_rows_iota = _soltel.device_rows_iota(telemetry_cap)
+
+    def tel_row(eps, e_row, e_col, e_sink, stats):
+        active = (
+            jnp.sum((e_row > 0).astype(i32))
+            + jnp.sum((e_col > 0).astype(i32))
+            + (e_sink > 0).astype(i32)
+        )
+        exc_pos = (
+            jnp.sum(jnp.maximum(e_row, 0))
+            + jnp.sum(jnp.maximum(e_col, 0))
+            + jnp.maximum(e_sink, 0)
+        )
+        return _soltel.device_row(eps, active, exc_pos, *stats)
+
+    def tel_write(tel, steps, row):
+        return _soltel.device_ring_write(
+            tel, steps, row, telemetry_cap, _tel_rows_iota
+        )
+
     def phase_body(state):
-        y, z, pr, pm, psink, eps, steps, done = state
+        if telemetry_cap:
+            y, z, pr, pm, psink, eps, steps, done, tel = state
+        else:
+            y, z, pr, pm, psink, eps, steps, done = state
         e_row, e_col, e_sink = _excesses(supply, y, z)
         any_active = jnp.any(e_row > 0) | jnp.any(e_col > 0) | (e_sink > 0)
 
         def do_step(_):
-            y2, z2, pr2, pm2, psink2 = transport_superstep(
-                wS, U, supply, col_cap, y, z, pr, pm, psink, eps
+            out = transport_superstep(
+                wS, U, supply, col_cap, y, z, pr, pm, psink, eps,
+                with_stats=bool(telemetry_cap),
             )
-            return y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False)
+            if not telemetry_cap:
+                y2, z2, pr2, pm2, psink2 = out
+                return y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False)
+            y2, z2, pr2, pm2, psink2, stats = out
+            tel2 = tel_write(
+                tel, steps, tel_row(eps, e_row, e_col, e_sink, stats)
+            )
+            return (
+                y2, z2, pr2, pm2, psink2, eps, steps + 1, jnp.bool_(False),
+                tel2,
+            )
 
         def next_phase(_):
             finished = eps <= 1
@@ -853,7 +914,7 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
                 y2, z2 = transport_saturate(
                     wS, U, col_cap, y, z, pr, pm, psink
                 )
-            return (
+            out = (
                 jnp.where(finished, y, y2),
                 jnp.where(finished, z, z2),
                 jnp.where(finished, pr, pr2),
@@ -863,6 +924,7 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
                 steps,
                 finished,
             )
+            return out + ((tel,) if telemetry_cap else ())
 
         return lax.cond(any_active, do_step, next_phase, operand=None)
 
@@ -871,14 +933,23 @@ def _transport_loop(wS, U, supply, col_cap, eps_init, alpha, max_supersteps,
     y0 = jnp.zeros((C, Mp1), i32)
     z0 = jnp.zeros((Mp1,), i32)
     state = (y0, z0, pr0, pm0, psink0, eps_init, i32(0), jnp.bool_(False))
-    y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
-        phase_cond, phase_body, state
-    )
+    if telemetry_cap:
+        state = state + (jnp.zeros((telemetry_cap, SOLTEL_WIDTH), i32),)
+        y, z, pr, pm, psink, eps, steps, done, tel = lax.while_loop(
+            phase_cond, phase_body, state
+        )
+    else:
+        y, z, pr, pm, psink, eps, steps, done = lax.while_loop(
+            phase_cond, phase_body, state
+        )
     e_row, e_col, e_sink = _excesses(supply, y, z)
     max_abs = jnp.maximum(
         jnp.max(jnp.abs(e_row)), jnp.maximum(jnp.max(jnp.abs(e_col)), jnp.abs(e_sink))
     )
-    return y, z, pm, steps, done & (max_abs == 0)
+    base = (y, z, pm, steps, done & (max_abs == 0))
+    if telemetry_cap:
+        return base + (tel,)
+    return base
 
 
 def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
@@ -979,7 +1050,7 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("alpha", "max_supersteps", "refine_waves")
+    jax.jit, static_argnames=("alpha", "max_supersteps", "refine_waves", "telemetry_cap")
 )
 def _solve_transport(
     wS,  # int32[C, Mp1] scaled costs (column Mp1-1 = unsched, 0)
@@ -990,12 +1061,16 @@ def _solve_transport(
     alpha: int = 8,
     max_supersteps: int = 20_000,
     refine_waves: int = 0,
+    telemetry_cap: int = 0,
 ):
     U = jnp.minimum(supply[:, None], col_cap[None, :])  # fwd arc capacity
-    y, z, pm, steps, converged = _transport_loop(
+    out = _transport_loop(
         wS, U, supply, col_cap, eps_init, alpha, max_supersteps, pm_init=pm0,
-        refine_waves=refine_waves,
+        refine_waves=refine_waves, telemetry_cap=telemetry_cap,
     )
+    y, z, pm, steps, converged = out[:5]
+    if telemetry_cap:
+        return y, pm, steps, converged, out[5]
     return y, pm, steps, converged
 
 
@@ -1110,31 +1185,85 @@ class LayeredTransportSolver:
     between full and incremental solver modes, placement/solver.go:60-90).
     """
 
-    def __init__(self, alpha: int = 8, max_supersteps: int = 20_000):
+    def __init__(self, alpha: int = 8, max_supersteps: int = 20_000,
+                 telemetry: Optional[int] = None):
         self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
+        #: soltel ring capacity override; None = module default (see
+        #: obs/soltel.resolve_cap). The fused Pallas transport kernel
+        #: carries no telemetry ring, so telemetry is only collected
+        #: where the XLA `_solve_transport` loop runs ANYWAY (CPU, or
+        #: a forced non-Pallas mode) — it must never silently swap the
+        #: TPU hot path off the fused kernel. Flows are bit-identical
+        #: either way by the kernel's parity contract.
+        self.telemetry = telemetry
         self.last_supersteps = 0
+        self.last_telemetry = None
 
     def reset(self) -> None:
         pass
 
     def solve_layered(self, lp: LayeredProblem) -> LayeredResult:
-        from ..ops import transport_solve
+        from ..obs import soltel
+        from ..ops import resolve_pallas, transport_solve
+
+        tel_cap = soltel.resolve_cap(self.telemetry)
+        if tel_cap and resolve_pallas()[0]:
+            # Pallas dispatch is live (TPU or forced on): keep the
+            # fused kernel and skip interior telemetry rather than
+            # silently demoting the hot path to the XLA loop. The
+            # superstep COUNT still reaches the registry via
+            # solve_traced/solve_layered consumers.
+            tel_cap = 0
+        captured = []  # (tel_buf, steps, converged) of the last attempt
 
         def solve(wS, sup, cap, eps_init):
-            y, _pm, steps, converged = transport_solve(
-                wS, sup, cap, eps_init,
-                alpha=self.alpha, max_supersteps=self.max_supersteps,
-            )
+            if tel_cap:
+                y, _pm, steps, converged, tel = _solve_transport(
+                    wS, sup, cap, eps_init,
+                    alpha=self.alpha, max_supersteps=self.max_supersteps,
+                    telemetry_cap=tel_cap,
+                )
+                captured.append((tel, steps, converged))
+            else:
+                y, _pm, steps, converged = transport_solve(
+                    wS, sup, cap, eps_init,
+                    alpha=self.alpha, max_supersteps=self.max_supersteps,
+                )
             return y, steps, converged
 
+        def decode_last(converged_override=None):
+            if not captured:
+                return None
+            tel, steps, converged = captured[-1]
+            return soltel.decode(
+                tel, int(steps), tel_cap, "layered", self.max_supersteps,
+                converged=(
+                    bool(converged)
+                    if converged_override is None
+                    else converged_override
+                ),
+                nodes=int(lp.supply.shape[0]),
+                arcs=int(lp.cost_cm.size),
+            )
+
+        self.last_telemetry = None
         try:
             res = solve_layered_host(
                 lp, pad=pad_geometry, solve=solve,
                 max_supersteps=self.max_supersteps,
             )
-        except RuntimeError:
+        except RuntimeError as e:
             self.last_supersteps = self.max_supersteps  # budget exhausted
+            tel = decode_last(converged_override=False)
+            self.last_telemetry = tel
+            if tel is not None and not isinstance(e, soltel.SolverStallError):
+                raise soltel.SolverStallError(
+                    str(e),
+                    reason=soltel.detect_stall(tel),
+                    telemetry=tel,
+                ) from e
             raise
         self.last_supersteps = res.supersteps
+        self.last_telemetry = decode_last()
         return res

@@ -132,6 +132,7 @@ class MegaSolver(FlowSolver):
         vmem_budget_bytes: Optional[int] = None,
         interpret: Optional[bool] = None,
         fallback: Optional[FlowSolver] = None,
+        telemetry: Optional[int] = None,
     ):
         from .layered import validate_alpha
         from ..ops.mcmf_pallas import MEGA_LANES, _MEGA_VMEM_BUDGET_BYTES
@@ -147,11 +148,13 @@ class MegaSolver(FlowSolver):
         )
         self.interpret = interpret
         self.fallback = fallback
+        self.telemetry = telemetry
         self._prev: Optional[np.ndarray] = None
         self._plan: Optional[MegaPlan] = None
         self._plan_dev: Optional[tuple] = None
         self._fits_ok_for: Optional[FlowProblem] = None
         self.last_supersteps = 0
+        self.last_telemetry = None
         self.last_refusal = ""
 
     def reset(self) -> None:
@@ -176,13 +179,17 @@ class MegaSolver(FlowSolver):
     def fits(self, problem: FlowProblem) -> bool:
         """Whether the megakernel can take this solve; on refusal
         `last_refusal` names why (the AutoSolver escalation reads it)."""
+        from ..obs import soltel
         from ..ops.mcmf_pallas import mega_fits_vmem
 
         m = len(problem.src)
         if m == 0 or problem.num_arcs == 0:
             self.last_refusal = "empty graph"
             return False
-        if not mega_fits_vmem(2 * m, self.lanes, self.vmem_budget_bytes):
+        if not mega_fits_vmem(
+            2 * m, self.lanes, self.vmem_budget_bytes,
+            telemetry=soltel.resolve_cap(self.telemetry) > 0,
+        ):
             self.last_refusal = (
                 f"{2 * m} entries exceed the VMEM tiling budget "
                 f"({self.vmem_budget_bytes} bytes)"
@@ -275,6 +282,9 @@ class MegaSolver(FlowSolver):
                 same = (prev_plan.src == src) & (prev_plan.dst == dst)
                 flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
 
+        from ..obs import soltel
+        from ..ops.mcmf_pallas import mega_telemetry_cap
+
         interpret = self._resolve_interpret()
         dev_args = (
             jnp.asarray(_pad_pow2(cap)),
@@ -285,6 +295,11 @@ class MegaSolver(FlowSolver):
         # different graph may rebuild self._plan before this dispatch
         # is complete()d (the async-pipelining seam)
         RL = (self._plan.R, self._plan.L)
+        tel_cap = soltel.resolve_cap(self.telemetry)
+        if tel_cap:
+            # ring clamped to one [R, L] entry tile (the +1-tile VMEM
+            # budget fits() charged); decode needs the effective cap
+            tel_cap = mega_telemetry_cap(RL[0], RL[1], tel_cap)
         fut = mcmf_loop_pallas(
             *dev_args,
             jnp.asarray(_pad_pow2(flow0)),
@@ -294,15 +309,17 @@ class MegaSolver(FlowSolver):
             alpha=self.alpha,
             max_supersteps=min(4096, self.max_supersteps),
             interpret=interpret,
+            telemetry_cap=tel_cap,
         )
         cold = (
             _pad_pow2(np.zeros(m, dtype=np.int32)),
             max(1, max_cost * n),
             interpret,
         )
-        return (problem, fut, (dev_args, plan_dev, RL, cold), None)
+        return (problem, fut, (dev_args, plan_dev, RL, cold, tel_cap), None)
 
     def complete(self, pending) -> FlowResult:
+        from ..obs import soltel
         from ..ops.mcmf_pallas import mcmf_loop_pallas
 
         problem, fut, rest, delegated = pending
@@ -311,16 +328,22 @@ class MegaSolver(FlowSolver):
             self.last_supersteps = getattr(
                 self.fallback, "last_supersteps", res.iterations
             )
+            self.last_telemetry = getattr(self.fallback, "last_telemetry", None)
             return res
         if fut is None:
+            self.last_telemetry = None
             return FlowResult(
                 flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
-        flow, steps, converged, p_overflow = fut
+        dev_args, plan_args, (R, L), (f0_cold, eps_cold, interpret), tel_cap = rest
+        tel_buf = None
+        if tel_cap:
+            flow, steps, converged, p_overflow, tel_buf = fut
+        else:
+            flow, steps, converged, p_overflow = fut
         if not (bool(converged) and not bool(p_overflow)):
-            dev_args, plan_args, (R, L), (f0_cold, eps_cold, interpret) = rest
-            flow, steps, converged, p_overflow = mcmf_loop_pallas(
+            out = mcmf_loop_pallas(
                 *dev_args,
                 jnp.asarray(f0_cold),
                 jnp.asarray(np.int32(eps_cold)),
@@ -329,16 +352,35 @@ class MegaSolver(FlowSolver):
                 alpha=self.alpha,
                 max_supersteps=self.max_supersteps,
                 interpret=interpret,
+                telemetry_cap=tel_cap,
             )
+            if tel_cap:
+                flow, steps, converged, p_overflow, tel_buf = out
+            else:
+                flow, steps, converged, p_overflow = out
         self.last_supersteps = int(steps)
+        # budget = the SOLVER's budget, not the warm attempt's 4096 cap
+        # (see jax_solver.complete)
+        self.last_telemetry = (
+            soltel.decode(
+                tel_buf, int(steps), tel_cap, "mega", self.max_supersteps,
+                converged=bool(converged) and not bool(p_overflow),
+                nodes=problem.num_nodes, arcs=len(problem.src),
+            )
+            if tel_buf is not None
+            else None
+        )
         if bool(p_overflow) or not bool(converged):
             self._prev = None
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
-            raise RuntimeError(
+            tel = self.last_telemetry
+            raise soltel.SolverStallError(
                 f"push-relabel did not converge within {self.max_supersteps} "
-                "supersteps; the flow problem may be infeasible"
+                "supersteps; the flow problem may be infeasible",
+                reason=soltel.detect_stall(tel) if tel is not None else None,
+                telemetry=tel,
             )
         flow_np = np.asarray(flow)[: len(problem.src)]
         if self.warm_start:

@@ -79,8 +79,17 @@ class PlacementSolver:
         problem, task_node_ids, pending, is_async = token
         if is_async:
             try:
-                with span("backend_solve", backend=type(self.backend).__name__):
+                with span("backend_solve", backend=type(self.backend).__name__) as sp:
                     result = self.backend.complete(pending)
+                    # async dispatches bypass solve_traced; publish the
+                    # solver-interior telemetry here instead (registry
+                    # histograms + per-superstep child spans + stall
+                    # detection — obs/soltel.py)
+                    tel = getattr(self.backend, "last_telemetry", None)
+                    if tel is not None:
+                        from ..obs import soltel
+
+                        soltel.publish(tel, sp)
             except BaseException:
                 get_profiler().solve_failed()  # stop an Nth-solve capture
                 raise

@@ -1,0 +1,126 @@
+"""The bench-trajectory ratchet (tools/bench_compare.py + `make
+bench-gate`): append normalizes bench records into trajectory entries,
+gate fails on >tolerance p50 regression within a (config, platform)
+series and never compares across platforms or against cpu-fallback
+readings."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tools.bench_compare import (
+    DEFAULT_TOLERANCE,
+    entry_from_record,
+    load_trajectory,
+)
+
+
+def _write(path, entries):
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+
+
+def _gate(path, tolerance=DEFAULT_TOLERANCE):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.bench_compare", "gate", str(path),
+         "--tolerance", str(tolerance)],
+        capture_output=True, text=True,
+    )
+
+
+def _entry(config, p50, platform="cpu", **kw):
+    return {"config": config, "platform": platform, "p50_ms": p50,
+            "commit": "t", **kw}
+
+
+def test_gate_passes_within_tolerance(tmp_path):
+    p = tmp_path / "traj.jsonl"
+    _write(p, [_entry("a", 10.0), _entry("a", 11.0)])
+    r = _gate(p)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_gate_fails_on_regression(tmp_path):
+    p = tmp_path / "traj.jsonl"
+    _write(p, [_entry("a", 10.0), _entry("a", 12.0)])
+    r = _gate(p)
+    assert r.returncode == 1
+    assert "REGRESSED" in r.stdout and "BENCH GATE FAILED" in r.stderr
+
+
+def test_gate_compares_last_two_only(tmp_path):
+    """A recovered regression does not keep failing the gate."""
+    p = tmp_path / "traj.jsonl"
+    _write(p, [_entry("a", 10.0), _entry("a", 20.0), _entry("a", 20.5)])
+    assert _gate(p).returncode == 0
+
+
+def test_gate_ignores_cross_platform_series(tmp_path):
+    p = tmp_path / "traj.jsonl"
+    _write(p, [_entry("a", 2.0, platform="tpu"), _entry("a", 50.0)])
+    r = _gate(p)
+    assert r.returncode == 0  # different platforms: no comparison
+
+
+def test_gate_skips_fallback_vs_device_baseline(tmp_path):
+    p = tmp_path / "traj.jsonl"
+    _write(p, [
+        _entry("a", 2.0),
+        _entry("a", 50.0, accelerator_unreachable=True),
+    ])
+    # same platform label but one is a cpu-fallback stamp: skipped
+    _write(p, [
+        {**_entry("a", 2.0)},
+        {**_entry("a", 50.0), "accelerator_unreachable": True},
+    ])
+    assert _gate(p).returncode == 0
+
+
+def test_gate_single_entry_series_passes(tmp_path):
+    p = tmp_path / "traj.jsonl"
+    _write(p, [_entry("a", 10.0), _entry("b", 5.0)])
+    assert _gate(p).returncode == 0
+
+
+def test_gate_rejects_bad_json_line(tmp_path):
+    p = tmp_path / "traj.jsonl"
+    p.write_text('{"config": "a"}\nnot json\n')
+    r = _gate(p)
+    assert r.returncode != 0
+
+
+def test_entry_from_record_normalizes():
+    rec = {
+        "metric": "p50 ... backend=jax/cpu",
+        "value": 12.5,
+        "vs_baseline": 0.8,
+        "config": "10kx1k",
+        "detail": {"supersteps_p50": 7, "supersteps_max": 40},
+    }
+    e = entry_from_record(rec)
+    assert e["config"] == "10kx1k" and e["platform"] == "cpu"
+    assert e["p50_ms"] == 12.5 and e["supersteps_p50"] == 7
+    assert "utc" in e and "commit" in e
+
+
+def test_entry_marks_fallback():
+    rec = {"metric": "p50 ... backend=device/cpu", "value": 1.0,
+           "accelerator_unreachable": True}
+    e = entry_from_record(rec, config="x")
+    assert e["accelerator_unreachable"] and e["platform"] == "cpu-fallback"
+
+
+def test_checked_in_trajectory_is_wellformed_and_gates_clean():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "BENCH_TRAJECTORY.jsonl")
+    entries = load_trajectory(path)
+    assert entries, "BENCH_TRAJECTORY.jsonl must not be empty"
+    for e in entries:
+        assert e.get("config") and e.get("p50_ms") is not None
+    assert _gate(path).returncode == 0, "checked-in trajectory must gate clean"

@@ -305,6 +305,103 @@ def test_mega_vmem_estimate_consistent_with_gate(bucket):
 
 
 # ---------------------------------------------------------------------------
+# Level 2: solver-telemetry contracts (obs/soltel.py, ISSUE 7)
+# ---------------------------------------------------------------------------
+
+#: normalized jaxpr hashes of every backend's TELEMETRY-OFF trace at
+#: bucket (20, 100), captured on the pre-telemetry tree (PR 7 base,
+#: jax 0.4.37) — the "no cost when off" contract: telemetry_cap=0 must
+#: trace the EXACT pre-soltel program, op for op. The hash normalizes
+#: source-location metadata (jaxpr_contracts._normalize_jaxpr_str), so
+#: a comment edit can't split it — but a jax upgrade that changes
+#: jaxpr printing will, and these pins must then be re-captured in the
+#: same commit as the upgrade (verify the off-trace is otherwise
+#: unchanged first).
+SOLTEL_OFF_BASELINE_HASHES = {
+    "jax": "92aa144400bd8869",
+    "ell": "9e101ad7b1bac615",
+    "mega": "2713247f0ce0fa0b",
+    # sharded traces over the conftest 8-virtual-device mesh; its hash
+    # is mesh-size-dependent (the other backends' are not)
+    "sharded": "b2c5ad0884934f47",
+    "layered": "efaf297e81829bd2",
+}
+
+
+@pytest.mark.parametrize("backend", sorted(SOLTEL_OFF_BASELINE_HASHES))
+def test_soltel_off_trace_is_the_pretelemetry_baseline(backend):
+    got = jc.jaxpr_hash(jc.traced(backend, 20, 100))
+    assert got == SOLTEL_OFF_BASELINE_HASHES[backend], (
+        f"{backend}: the telemetry-OFF trace drifted from the "
+        "pre-telemetry baseline — disabled solver telemetry must cost "
+        "zero traced ops (see SOLTEL_OFF_BASELINE_HASHES)"
+    )
+
+
+@pytest.mark.parametrize("backend", sorted(SOLTEL_OFF_BASELINE_HASHES))
+def test_soltel_on_changes_and_off_matches_default(backend):
+    """Sanity for the pin above: telemetry-on traces a DIFFERENT
+    program (the contract isn't vacuous), and cap=0 is the default.
+    Every soltel contract test traces cap=512 so the lru cache shares
+    the (expensive) abstract traces across the suite."""
+    off = jc.jaxpr_hash(jc.traced(backend, 20, 100, telemetry_cap=0))
+    on = jc.jaxpr_hash(jc.traced(backend, 20, 100, telemetry_cap=512))
+    assert off == jc.jaxpr_hash(jc.traced(backend, 20, 100))
+    assert on != off
+
+
+@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
+def test_soltel_mega_gather_budget_unchanged(bucket):
+    """Telemetry must add ZERO gathers to the megakernel: the counters
+    are reductions over VMEM state the superstep already holds, and
+    the ring write is a masked elementwise select."""
+    report = jc.check_jaxpr(
+        "mega", jc.traced("mega", *bucket, telemetry_cap=512)
+    )
+    assert report.hbm_loop_gathers == 0
+    assert report.kernel_gathers == jc.MEGA_KERNEL_PERM_GATHERS
+    assert report.ok_64bit and report.ok_scatter
+
+
+@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
+def test_soltel_mega_vmem_estimate_within_one_tile(bucket):
+    """The telemetry ring is clamped to one [R, L] entry tile
+    (mega_telemetry_cap), so the counted VMEM estimate grows by
+    exactly 1 tile over _MEGA_LIVE_TILES — matching what
+    mega_fits_vmem(telemetry=True) budgets."""
+    from ksched_tpu.ops.mcmf_pallas import _MEGA_LIVE_TILES
+
+    est = jc.estimate_mega_vmem(
+        jc.traced("mega", *bucket, telemetry_cap=512)
+    )
+    assert est.extra_tiles == 1
+    assert est.est_tiles <= _MEGA_LIVE_TILES + 1
+    assert est.all_operands_on_chip
+    assert est.gate_is_safe
+
+
+@pytest.mark.parametrize("backend", ("jax", "mega", "layered"))
+def test_soltel_on_pow2_bucket_hash_stable(backend):
+    """The recompile detector holds WITH telemetry on: the ring shape
+    is a function of the pow2 bucket alone, never the raw size. One
+    pair per backend — the off-trace pairs already sweep all three;
+    this guards the telemetry shapes specifically."""
+    raw_a, raw_b = BUCKET_PAIRS[backend][0]
+    ha = jc.jaxpr_hash(jc.traced(backend, *raw_a, telemetry_cap=512))
+    hb = jc.jaxpr_hash(jc.traced(backend, *raw_b, telemetry_cap=512))
+    assert ha == hb, f"{backend}: telemetry-on recompile hazard {raw_a} vs {raw_b}"
+
+
+@pytest.mark.parametrize("backend", ("jax", "ell", "layered", "sharded"))
+def test_soltel_on_no_64bit_no_scatter(backend):
+    report = jc.check_jaxpr(
+        backend, jc.traced(backend, 20, 100, telemetry_cap=512)
+    )
+    assert report.ok_64bit, report.violations_64bit
+    assert report.ok_scatter, report.scatter_eqns
+
+
+# ---------------------------------------------------------------------------
 # Level 2: negative tests — each contract detects a seeded violation
 # ---------------------------------------------------------------------------
 

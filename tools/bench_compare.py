@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""The bench-trajectory ratchet: append runs, gate regressions.
+
+`BENCH_TRAJECTORY.jsonl` is the checked-in latency history: one JSON
+line per bench run with the config, backend, p50, and superstep
+detail. `append` folds a fresh bench record (the JSON line bench.py
+prints, or a BENCH_*.json artifact) into it; `gate` (the `make
+bench-gate` entry) fails when any config's NEWEST entry regressed
+more than the tolerance vs its PREVIOUS entry — the committed
+equivalent of "don't merge a p50 regression", enforceable without
+re-running the bench in CI.
+
+Cross-platform readings don't gate each other: entries compare only
+within the same (config, platform) series, and entries stamped
+`accelerator_unreachable` are never used as a baseline for device
+readings.
+
+Usage:
+    python tools/bench_compare.py append TRAJ.jsonl --from-bench out.json \
+        [--config NAME] [--note TEXT]
+    python tools/bench_compare.py gate TRAJ.jsonl [--tolerance 0.15]
+    python tools/bench_compare.py show TRAJ.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+DEFAULT_TOLERANCE = 0.15
+
+
+def _git_commit() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _platform_of(record: dict) -> str:
+    if record.get("accelerator_unreachable"):
+        return "cpu-fallback"
+    metric = record.get("metric", "")
+    if "backend=" in metric:
+        return metric.rsplit("/", 1)[-1].strip()
+    return "unknown"
+
+
+def entry_from_record(record: dict, config: Optional[str] = None,
+                      note: Optional[str] = None) -> dict:
+    """Normalize one bench.py JSON record into a trajectory entry."""
+    detail = record.get("detail") or {}
+    entry = {
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "commit": _git_commit(),
+        "config": config or record.get("config") or "10kx1k",
+        "platform": _platform_of(record),
+        "metric": record.get("metric", ""),
+        "p50_ms": record.get("value"),
+        "vs_baseline": record.get("vs_baseline"),
+    }
+    for key in ("supersteps_p50", "supersteps_p99", "supersteps_max"):
+        if key in detail:
+            entry[key] = detail[key]
+    if record.get("accelerator_unreachable"):
+        entry["accelerator_unreachable"] = True
+    if note:
+        entry["note"] = note
+    return entry
+
+
+def load_trajectory(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i + 1}: bad JSON line: {e}")
+    return out
+
+
+def append_cmd(args) -> int:
+    with open(args.from_bench) as f:
+        text = f.read().strip()
+    # accept either a single JSON object or JSONL (take the last
+    # bench record line, skipping suite provenance stamps)
+    records = []
+    try:
+        doc = json.loads(text)
+        records = [doc]
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if not rec.get("suite_stamp"):
+                records.append(rec)
+    if not records:
+        raise SystemExit(f"no bench records in {args.from_bench}")
+    wrote = 0
+    with open(args.trajectory, "a") as f:
+        for rec in records:
+            if rec.get("value") is None:
+                print(f"# skipping failed record: {rec.get('metric')}",
+                      file=sys.stderr)
+                continue
+            entry = entry_from_record(rec, config=args.config, note=args.note)
+            f.write(json.dumps(entry) + "\n")
+            wrote += 1
+    print(f"appended {wrote} entr{'y' if wrote == 1 else 'ies'} to "
+          f"{args.trajectory}")
+    return 0
+
+
+def _series_key(entry: dict):
+    return (entry.get("config"), entry.get("platform"))
+
+
+def gate_cmd(args) -> int:
+    entries = load_trajectory(args.trajectory)
+    if not entries:
+        raise SystemExit(f"{args.trajectory} is empty; nothing to gate")
+    series = {}
+    for e in entries:
+        if e.get("p50_ms") is None:
+            continue
+        series.setdefault(_series_key(e), []).append(e)
+    failures = []
+    checked = 0
+    for (config, platform), es in sorted(series.items()):
+        if len(es) < 2:
+            continue
+        prev, last = es[-2], es[-1]
+        # a cpu-fallback reading must not gate (or baseline) a device
+        # series; same-platform by key, but double-check the stamp
+        if prev.get("accelerator_unreachable") != last.get(
+            "accelerator_unreachable"
+        ):
+            continue
+        checked += 1
+        p_prev, p_last = float(prev["p50_ms"]), float(last["p50_ms"])
+        ratio = (p_last - p_prev) / max(p_prev, 1e-9)
+        tag = f"{config} [{platform}]"
+        verdict = "OK" if ratio <= args.tolerance else "REGRESSED"
+        print(
+            f"{tag:<40} p50 {p_prev:9.3f} -> {p_last:9.3f} ms "
+            f"({ratio:+8.1%})  {verdict}"
+        )
+        if ratio > args.tolerance:
+            failures.append(
+                f"{tag}: p50 {p_prev:.3f} -> {p_last:.3f} ms "
+                f"(+{ratio:.1%} > {args.tolerance:.0%} tolerance; "
+                f"{prev.get('commit')} -> {last.get('commit')})"
+            )
+    if not checked:
+        print("gate: no series has two comparable entries yet (pass)")
+        return 0
+    if failures:
+        print("\nBENCH GATE FAILED:", file=sys.stderr)
+        for f_ in failures:
+            print(f"  {f_}", file=sys.stderr)
+        return 1
+    print(f"bench gate OK: {checked} series within "
+          f"{args.tolerance:.0%} of their previous entry")
+    return 0
+
+
+def show_cmd(args) -> int:
+    entries = load_trajectory(args.trajectory)
+    print(f"{'utc':<22} {'commit':<9} {'config':<22} {'platform':<13} "
+          f"{'p50_ms':>9} {'ss_p50':>7}")
+    for e in entries:
+        p50 = e.get("p50_ms")
+        p50_s = f"{p50:>9.3f}" if p50 is not None else f"{'—':>9}"
+        print(
+            f"{e.get('utc', ''):<22} {e.get('commit', ''):<9} "
+            f"{e.get('config', ''):<22} {e.get('platform', ''):<13} "
+            f"{p50_s} {e.get('supersteps_p50', ''):>7}"
+        )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap_append = sub.add_parser("append", help="fold a bench record in")
+    ap_append.add_argument("trajectory")
+    ap_append.add_argument("--from-bench", required=True,
+                           help="bench.py output JSON (line or artifact)")
+    ap_append.add_argument("--config", default=None,
+                           help="override the config name")
+    ap_append.add_argument("--note", default=None)
+    ap_append.set_defaults(fn=append_cmd)
+    ap_gate = sub.add_parser("gate", help="fail on p50 regression")
+    ap_gate.add_argument("trajectory")
+    ap_gate.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                         help="max allowed relative p50 increase "
+                         "(default 0.15)")
+    ap_gate.set_defaults(fn=gate_cmd)
+    ap_show = sub.add_parser("show", help="tabulate the trajectory")
+    ap_show.add_argument("trajectory")
+    ap_show.set_defaults(fn=show_cmd)
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,9 +11,15 @@ shape — point it at whichever file a run left behind:
   `ksched_round_phase_ms` histogram buckets (log-linear interpolation
   within a bucket), plus a counter table;
 - **flight-recorder dump** (`flight_<reason>_r*.json`): the ring's
-  embedded RoundRecords, exact percentiles as for JSONL;
+  embedded RoundRecords, exact percentiles as for JSONL — plus the
+  embedded `solver_stalls` (structured stall reasons with their
+  telemetry tails, rendered as convergence tables);
 - **Chrome trace JSON** (`SpanTracer.dump`, `--trace-out`): per-span-
-  name duration percentiles over the trace events.
+  name duration percentiles over the trace events;
+- **solver telemetry JSON** (`SolveTelemetry.to_dict()`, e.g.
+  `tools/superstep_trace.py --out`): the per-superstep convergence
+  table — eps, active/excess, pushes, relabels, saturated arcs, work
+  per executed superstep (obs/soltel.py taxonomy).
 
 Usage: python tools/obs_report.py DUMP [--phase total]
 """
@@ -124,6 +130,74 @@ def report_snapshot(metrics: dict, phase_metric: str = "ksched_round_phase_ms") 
             print(f"{series:<44} {sample['value']:>14g}")
 
 
+def report_convergence(tel: dict, max_rows: int = 0) -> None:
+    """Per-superstep convergence table from a `solver_telemetry` dict
+    (obs/soltel.SolveTelemetry.to_dict(), or a stall event's
+    `telemetry_tail` re-wrapped). THE one renderer for solver-interior
+    rows — superstep_trace.py and the flight-dump view both call it."""
+    cols = tel.get("cols") or ["eps", "active", "excess", "pushed",
+                               "relabels", "saturated", "work"]
+    rows = tel.get("rows") or []
+    start = int(tel.get("start_step", 0))
+    head = f"solver telemetry: backend={tel.get('backend', '?')} "
+    if "steps" in tel:
+        head += f"steps={tel['steps']}"
+        if tel.get("budget"):
+            head += f"/{tel['budget']} budget"
+    if tel.get("truncated"):
+        head += (f" TRUNCATED (ring kept the final {len(rows)} of "
+                 f"{tel.get('steps', '?')} supersteps)")
+    if "converged" in tel:
+        head += "" if tel["converged"] else "  NOT CONVERGED"
+    print(head)
+    if not rows:
+        print("  (no supersteps recorded)")
+        return
+    shown = rows if not max_rows else rows[-max_rows:]
+    offset = start + (len(rows) - len(shown))
+    width = max(len(c) for c in cols) + 2
+    print(f"{'step':>8} " + " ".join(f"{c:>{width}}" for c in cols))
+    for i, row in enumerate(shown):
+        print(
+            f"{offset + i:>8} "
+            + " ".join(f"{int(v):>{width}}" for v in row[: len(cols)])
+        )
+    # phase summary: supersteps per eps value, in order
+    phases = []
+    for row in rows:
+        e = int(row[0])
+        if phases and phases[-1][0] == e:
+            phases[-1][1] += 1
+        else:
+            phases.append([e, 1])
+    if len(phases) > 1:
+        print("phases: " + "  ".join(f"eps={e}: {k}" for e, k in phases))
+
+
+def report_stalls(stalls: list) -> None:
+    """Structured solver stall events (a flight dump's
+    `solver_stalls`), each with its telemetry-tail convergence table."""
+    print(f"solver stalls: {len(stalls)} event(s)")
+    for i, ev in enumerate(stalls):
+        line = (f"  [{i}] kind={ev.get('kind')} rung={ev.get('rung', '-')} "
+                f"backend={ev.get('backend', '-')} "
+                f"supersteps={ev.get('supersteps', '-')}")
+        print(line)
+        if ev.get("detail") or ev.get("error"):
+            print(f"      {ev.get('detail') or ev.get('error')}")
+        tail = ev.get("telemetry_tail")
+        if tail:
+            report_convergence(
+                {
+                    "cols": ev.get("telemetry_cols"),
+                    "rows": tail,
+                    "start_step": ev.get("telemetry_start_step", 0),
+                    "backend": ev.get("backend", "?"),
+                    "truncated": ev.get("telemetry_truncated", False),
+                }
+            )
+
+
 def report_trace(events: list) -> None:
     """Per-span-name duration percentiles from Chrome trace events."""
     by_name: dict = {}
@@ -147,6 +221,12 @@ def load_and_report(path: str, phase_metric: str) -> None:
     except json.JSONDecodeError:
         doc = None  # multi-line JSONL: one record per line
     if isinstance(doc, dict):
+        if "solver_telemetry" in doc:
+            report_convergence(doc["solver_telemetry"])
+            return
+        if "cols" in doc and "rows" in doc:
+            report_convergence(doc)  # bare SolveTelemetry.to_dict()
+            return
         if "metrics" in doc:
             report_snapshot(doc["metrics"], phase_metric)
             return
@@ -154,6 +234,9 @@ def load_and_report(path: str, phase_metric: str) -> None:
             print(f"flight dump: reason={doc.get('reason')} "
                   f"rounds_seen={doc.get('rounds_seen')}")
             report_records([entry["record"] for entry in doc["rounds"]])
+            if doc.get("solver_stalls"):
+                print()
+                report_stalls(doc["solver_stalls"])
             return
         if "traceEvents" in doc:
             report_trace(doc["traceEvents"])

@@ -181,12 +181,32 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
         machine_flap_prob=0.008,
         machine_flap_rounds=(2, 5),
         solver_fault_prob=0.06,
-        solver_total_outage_prob=0.01,
+        solver_total_outage_prob=getattr(args, "solver_outage_prob", None)
+        if getattr(args, "solver_outage_prob", None) is not None
+        else 0.01,
     )
     injector = FaultInjector(policy)
     api = ChaosClusterAPI(SyntheticClusterAPI(), injector)
     tracer = RoundTracer()
     hb_timeout_s = 2.5  # a 3-round flap kills a machine; 2-round flaps survive
+
+    # optional flight recorder (the obs smoke's stall-dump assertion):
+    # NOOP rounds auto-dump the ring, and each dump embeds the soltel
+    # stall ring — the structured reasons + telemetry tails the
+    # degradation ladder deposited (docs/observability.md)
+    flight = None
+    span_tracer = None
+    flight_dir = getattr(args, "flight_dir", None)
+    if flight_dir:
+        from ksched_tpu.obs import FlightRecorder, SpanTracer
+        from ksched_tpu.obs import soltel
+
+        soltel.reset_stalls()  # assert THIS run's stalls, not a prior run's
+        flight = FlightRecorder(
+            capacity=32, dump_dir=flight_dir, registry=reg,
+            min_rounds_between_dumps=8,
+        )
+        span_tracer = SpanTracer().install()
 
     def make_service():
         return SchedulerService(
@@ -197,6 +217,8 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
             injector=injector,
             tracer=tracer,
             round_deadline_s=30.0,
+            flight=flight,
+            span_tracer=span_tracer,
         )
 
     svc = make_service()
@@ -285,6 +307,8 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
                     injector=injector,
                     tracer=tracer,
                     round_deadline_s=30.0,
+                    flight=flight,
+                    span_tracer=span_tracer,
                 )
             svc.enable_heartbeats(machine_timeout_s=hb_timeout_s, task_timeout_s=1e9)
             assert dict(svc.scheduler.task_bindings) == before_bindings, (
@@ -319,6 +343,43 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
         f"degradations={degr} noop_rounds={noops} restores={restores} "
         f"final_bound={len(placements)}"
     )
+    if span_tracer is not None:
+        span_tracer.uninstall()
+    if getattr(args, "assert_stall_flight", False):
+        # the solver-interior acceptance check: a seeded nonconvergence
+        # fault (ladder exhaustion → NOOP round) must have produced a
+        # flight dump whose solver_stalls carry the stall detector's
+        # STRUCTURED reason and the final supersteps of telemetry
+        import json as _json
+
+        assert flight is not None, "--assert-stall-flight needs --flight-dir"
+        assert flight.dumps, (
+            "no flight dump was written: the fault schedule produced no "
+            "NOOP round — raise --solver-outage-prob or the round count"
+        )
+        with open(flight.dumps[-1]) as fh:
+            dump = _json.load(fh)
+        stalls = dump.get("solver_stalls") or []
+        assert stalls, "flight dump has no solver_stalls section"
+        kinds = {s.get("kind") for s in stalls}
+        assert kinds & {
+            "injected_fault", "superstep_budget_exhausted",
+            "excess_plateau", "eps_plateau", "rejected_input",
+        }, f"no structured stall reason in dump (kinds={kinds})"
+        with_tail = [s for s in stalls if s.get("telemetry_tail")]
+        assert with_tail, (
+            "no stall event carries a telemetry tail — solver-interior "
+            "telemetry was not recorded before the failure"
+        )
+        cols = with_tail[-1].get("telemetry_cols")
+        assert cols and cols[0] == "eps", f"bad telemetry cols {cols}"
+        log(
+            f"STALL FLIGHT OK: {len(flight.dumps)} dump(s); last carries "
+            f"{len(stalls)} structured stall reason(s) "
+            f"({sorted(k for k in kinds if k)}), "
+            f"{len(with_tail)} with a telemetry tail of "
+            f"{len(with_tail[-1]['telemetry_tail'])} supersteps"
+        )
     if server is not None:
         # scrape our own live endpoint (text format over a real socket)
         # and reconcile it against the injector + the RoundRecord sums
@@ -391,6 +452,21 @@ def main() -> int:
                     "totals at exit (the obs smoke)")
     ap.add_argument("--obs-out", metavar="PATH", default=None,
                     help="write the metrics-registry snapshot JSON at exit")
+    ap.add_argument("--flight-dir", metavar="DIR", default=None,
+                    help="chaos mode: attach a flight recorder (+ span "
+                    "tracer); NOOP rounds auto-dump the ring with the "
+                    "solver-stall events embedded")
+    ap.add_argument("--assert-stall-flight", action="store_true",
+                    help="chaos mode: require >=1 flight dump whose "
+                    "solver_stalls carry a structured reason and a "
+                    "telemetry tail (the obs smoke's solver-interior "
+                    "acceptance check)")
+    ap.add_argument("--solver-outage-prob", type=float, default=None,
+                    metavar="P",
+                    help="chaos mode: override solver_total_outage_prob "
+                    "(default 0.01); the obs smoke raises it so a NOOP "
+                    "round (and its flight dump) fires within the short "
+                    "soak")
     args = ap.parse_args()
     if args.machines is None:  # per-mode default (device soak vs chaos)
         args.machines = 10 if args.chaos else 500

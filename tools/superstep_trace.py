@@ -1,9 +1,18 @@
 #!/usr/bin/env python
 """Numpy step-by-step replication of the transport superstep loop, for
-inspecting the dynamics of tail rounds (what are 5000 supersteps doing?).
-Mirrors solver/layered.py transport_superstep/_transport_loop exactly;
-parity with the JAX solver is asserted on the final objective."""
+inspecting the dynamics of tail rounds (what are 5000 supersteps
+doing?). Mirrors solver/layered.py transport_superstep/_transport_loop
+exactly; parity with the JAX solver is asserted on the final objective.
 
+Folded into the solver-telemetry path (obs/soltel.py): the per-step
+counters are recorded in the SOLTEL_COLS taxonomy — the same rows the
+compiled backends emit on device — and rendered through the one shared
+convergence-table view (tools/obs_report.py report_convergence), so
+this tracer and the in-kernel telemetry cannot drift apart. `--out`
+writes a `solver_telemetry` JSON that obs_report.py renders directly.
+"""
+
+import json
 import os
 import sys
 
@@ -11,6 +20,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
+
+from ksched_tpu.obs.soltel import SOLTEL_COLS, SOLTEL_WIDTH
 
 BIG = np.int64(1 << 30)
 BIG_D = np.int64(1 << 28)
@@ -54,7 +65,10 @@ def price_refine(wS, U, col_cap, y, z, pr, pm, psink, eps, waves):
     return pr, pm, psink
 
 
-def superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps, stats=None):
+def superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps, rows=None):
+    """One synchronous wave — the numpy twin of layered.py
+    transport_superstep(with_stats=True): when `rows` is given, one
+    SOLTEL_COLS-ordered counter row is appended per call."""
     e_row, e_col, e_sink = excesses(supply, y, z)
     rcf = wS + pr[:, None] - pm[None, :]
 
@@ -103,26 +117,35 @@ def superstep(wS, U, supply, col_cap, y, z, pr, pm, psink, eps, stats=None):
     relabel_sink = (e_sink > 0) & (pushed_sink == 0)
     psink2 = np.where(relabel_sink, cand_sink - eps, psink)
 
-    if stats is not None:
-        stats.append(dict(
-            pushed=int(delta_f.sum() + delta_s.sum() + delta_b.sum() + delta_zb.sum()),
-            relabels_r=int(relabel_row.sum()), relabels_c=int(relabel_col.sum()),
-            excess_r=int(np.maximum(e_row, 0).sum()),
-            excess_c=int(np.maximum(e_col, 0).sum()),
-            e_sink=int(e_sink),
-            active_c=int((e_col > 0).sum()),
-        ))
+    if rows is not None:
+        # SOLTEL_COLS order: eps, active, excess, pushed, relabels,
+        # saturated, work — exactly layered.py's with_stats counters
+        rows.append([
+            int(eps),
+            int((e_row > 0).sum() + (e_col > 0).sum() + (e_sink > 0)),
+            int(np.maximum(e_row, 0).sum() + np.maximum(e_col, 0).sum()
+                + max(int(e_sink), 0)),
+            int(delta_f.sum() + deltaA.sum() + delta_zb.sum()),
+            int(relabel_row.sum() + relabel_col.sum() + int(relabel_sink)),
+            int(((U > 0) & (y >= U)).sum()
+                + ((col_cap > 0) & (z >= col_cap)).sum()),
+            int((r_adm > 0).sum() + (colA > 0).sum() + (zb_adm > 0).sum()),
+        ] + [0] * (SOLTEL_WIDTH - 7))
     return y2, z2, pr2, pm2, np.int64(psink2)
 
 
 def run(wS, supply, col_cap, eps_sched, refine_waves=8, verbose_every=500,
         max_steps=40000):
+    """Returns (y, z, rows, converged): rows is the full SOLTEL_COLS
+    trace; converged is False when a PHASE blew the max_steps budget
+    (the budget is per phase, matching the historical tracer — a slow
+    multi-phase instance whose every phase drains is not a stall)."""
     U = np.minimum(supply[:, None], col_cap[None, :]).astype(np.int64)
     pr, pm, psink = tighten(wS, U, col_cap)
     C, Mp1 = wS.shape
     y = np.zeros((C, Mp1), np.int64)
     z = np.zeros(Mp1, np.int64)
-    tot = 0
+    rows: list = []
     for phase, eps in enumerate(eps_sched):
         if refine_waves and phase > 0:
             pr, pm, psink = price_refine(wS, U, col_cap, y, z, pr, pm, psink,
@@ -130,26 +153,41 @@ def run(wS, supply, col_cap, eps_sched, refine_waves=8, verbose_every=500,
         y, z = saturate_eps(wS, U, col_cap, y, z, pr, pm, psink,
                             0 if phase == 0 else eps)
         k = 0
-        stats = []
         while True:
             er, ec, es = excesses(supply, y, z)
             if not (er > 0).any() and not (ec > 0).any() and es <= 0:
                 break
             y, z, pr, pm, psink = superstep(wS, U, supply, col_cap, y, z,
-                                            pr, pm, psink, eps, stats)
+                                            pr, pm, psink, eps, rows)
             k += 1
-            tot += 1
             if verbose_every and k % verbose_every == 0:
-                s = stats[-1]
-                print(f"  eps={eps} step {k}: {s}")
+                print(f"  eps={eps} step {k}: "
+                      f"{dict(zip(SOLTEL_COLS, rows[-1]))}")
             if k > max_steps:
                 print("  STALL")
-                return y, z, tot
-        if stats:
-            pushes = sum(s["pushed"] for s in stats)
-            print(f"phase eps={eps}: {k} steps, {pushes} unit-pushes, "
-                  f"final excess drained")
-    return y, z, tot
+                return y, z, rows, False
+        if k:
+            print(f"phase eps={eps}: {k} steps, "
+                  f"{sum(r[3] for r in rows[-k:])} unit-pushes, "
+                  "final excess drained")
+    return y, z, rows, True
+
+
+def rows_to_telemetry(rows, budget: int, converged: bool = True) -> dict:
+    """The host tracer's rows as a `solver_telemetry` dict — the same
+    shape SolveTelemetry.to_dict() produces, consumable by
+    obs_report.py's convergence view."""
+    return {
+        "backend": "superstep_trace",
+        "steps": len(rows),
+        "budget": budget,
+        "cap": len(rows),
+        "truncated": False,
+        "start_step": 0,
+        "converged": converged,
+        "cols": list(SOLTEL_COLS),
+        "rows": [[int(v) for v in row] for row in rows],
+    }
 
 
 if __name__ == "__main__":
@@ -163,6 +201,12 @@ if __name__ == "__main__":
     ap.add_argument("--alpha", type=int, default=8)
     ap.add_argument("--refine", type=int, default=8)
     ap.add_argument("--every", type=int, default=500)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the trace as solver_telemetry JSON "
+                    "(tools/obs_report.py renders it)")
+    ap.add_argument("--table", action="store_true",
+                    help="print the per-superstep convergence table "
+                    "(last 64 rows) via obs_report.report_convergence")
     args = ap.parse_args()
 
     from ksched_tpu.solver.layered import default_eps0
@@ -186,7 +230,19 @@ if __name__ == "__main__":
         e = max(1, e // args.alpha)
     print(f"instance {args.k}: supply={supply.tolist()} "
           f"cap={int(col_cap[:M].sum())} sched={sched}")
-    y, z, tot = run(wS, supply, col_cap, sched, refine_waves=args.refine,
-                    verbose_every=args.every)
+    y, z, rows, converged = run(
+        wS, supply, col_cap, sched, refine_waves=args.refine,
+        verbose_every=args.every,
+    )
     obj = int((y[:, :M] * wP[:, :M]).sum())
-    print(f"total steps={tot} obj={obj} placed={int(y[:, :M].sum())}")
+    print(f"total steps={len(rows)} obj={obj} placed={int(y[:, :M].sum())}"
+          + ("" if converged else "  NOT CONVERGED (phase budget blown)"))
+    tel = rows_to_telemetry(rows, budget=40000, converged=converged)
+    if args.table:
+        from tools.obs_report import report_convergence
+
+        report_convergence(tel, max_rows=64)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"solver_telemetry": tel}, f)
+        print(f"telemetry -> {args.out}")
