@@ -12,6 +12,11 @@
 #                     flight dump carrying the stall detector's
 #                     structured reason + telemetry tail
 #                     (docs/observability.md)
+#   make pipeline-smoke  short double-buffered chaos soak asserting
+#                     bit-identical placements across the sync,
+#                     pipelined, and pipelined+device-resident service
+#                     loops, including mid-flight rung degradation
+#                     (docs/round_pipeline.md)
 #   make bench-gate   check BENCH_TRAJECTORY.jsonl: fail if any config's
 #                     newest p50 regressed >15% vs its previous entry
 #                     (tools/bench_compare.py; append runs with
@@ -25,7 +30,7 @@ SHELL := /bin/bash
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test chaos-smoke obs-smoke bench-gate verify baseline
+.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke bench-gate verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
@@ -43,6 +48,11 @@ obs-smoke:
 	  --flight-dir /tmp/ksched_obs_smoke_flight --solver-outage-prob 0.08 \
 	  --assert-stall-flight
 
+pipeline-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/soak.py --chaos \
+	  --rounds 64 --chunk 32 --seed 5 --machines 6 --slots 8 \
+	  --chaos-restore-every 32 --verify-loop-parity
+
 bench-gate:
 	$(PY) tools/bench_compare.py gate BENCH_TRAJECTORY.jsonl
 
@@ -55,7 +65,7 @@ test:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-verify: lint test chaos-smoke obs-smoke
+verify: lint test chaos-smoke obs-smoke pipeline-smoke
 
 baseline:
 	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

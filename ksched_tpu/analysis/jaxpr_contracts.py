@@ -14,7 +14,12 @@ assert:
 - **no-scatter**: zero scatter-family primitives in any backend's
   solve. TPU serializes scatter-adds (~68 ms for a 64k segment_sum,
   jax_solver.py header); every segment reduction must stay in
-  cumsum/gather/associative-scan form.
+  cumsum/gather/associative-scan form. ONE program holds a scoped
+  exemption: the device-resident delta apply
+  (graph/device_export.delta_apply_fn), which scatters O(churn)-sized
+  packed records once per round — `trace_delta_apply` pins that it
+  scatters (the exemption is real), stays 32-bit, and hashes stably
+  within a pow2 record bucket; every solver program stays at zero.
 - **mega gather budget** (locking in the megakernel's zero-HBM-gather
   claim, ops/mcmf_pallas.py): inside the mega `pallas_call` body every
   operand is VMEM/SMEM-resident by BlockSpec construction, the only
@@ -413,6 +418,61 @@ def trace_sharded(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0)
     return jax.make_jaxpr(fn)(
         _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()), _sds(()),
         *plan_sds,
+    )
+
+
+def trace_jax_warmp(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
+    """The warm-potentials variant of the CSR solve: use_warm_p=True
+    takes the previous round's device-resident prices and skips the
+    tightening pass. A distinct traced program — the default
+    (warm_p=None, use_warm_p=False) trace stays byte-identical to the
+    pinned pre-warm_p baseline, which test_static_analysis pins."""
+    from ..solver.jax_solver import _solve_mcmf
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    fn = functools.partial(
+        _solve_mcmf, alpha=8, max_supersteps=4096, tighten_sweeps=32,
+        telemetry_cap=telemetry_cap, use_warm_p=True,
+    )
+    e = 2 * m
+    return jax.make_jaxpr(fn)(
+        _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()),
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)),
+        _sds((e,), jnp.bool_), _sds((e,)),
+        _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+        _sds((n,)),  # warm_p
+    )
+
+
+def trace_delta_apply(ka_raw: int, kn_raw: int, n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the ONE scatter-exempt program: the
+    device-resident delta apply over pow2-bucketed record counts
+    (graph/device_export.delta_apply_fn)."""
+    from ..graph.device_export import (
+        ARC_RECORD_COLS,
+        NODE_RECORD_COLS,
+        delta_apply_fn,
+        pad_record_count,
+    )
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    ka = pad_record_count(ka_raw)
+    kn = pad_record_count(kn_raw)
+    return jax.make_jaxpr(delta_apply_fn())(
+        _sds((n,)), _sds((m,)), _sds((m,)), _sds((m,)), _sds((m,)),
+        _sds((ka, ARC_RECORD_COLS)), _sds((kn, NODE_RECORD_COLS)),
+    )
+
+
+def trace_warm_flow(n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the device warm-flow carry
+    (graph/device_export.device_warm_flow_fn) — elementwise only, so
+    it must stay scatter- AND gather-free."""
+    from ..graph.device_export import device_warm_flow_fn
+
+    _n, m = bucketed_sizes(n_raw, m_raw)
+    return jax.make_jaxpr(device_warm_flow_fn())(
+        _sds((m,)), _sds((m,)), _sds((m,)), _sds((m,)), _sds((m,)), _sds((m,))
     )
 
 

@@ -77,6 +77,8 @@ class SchedulerService:
         round_deadline_s: float = 0.0,
         flight: Optional[FlightRecorder] = None,
         span_tracer: Optional[SpanTracer] = None,
+        pipeline: bool = False,
+        device_resident: bool = False,
         _restored: Optional[Tuple] = None,
     ) -> None:
         self.api = api
@@ -84,6 +86,18 @@ class SchedulerService:
         self.tracer = tracer
         self.flight = flight
         self.span_tracer = span_tracer
+        #: double-buffered round mode: each round DISPATCHES its solve,
+        #: then posts the PREVIOUS round's bindings while the device
+        #: crunches, then synchronizes/decodes/applies — so binding
+        #: POSTs (and, in run(), the next poll) overlap the in-flight
+        #: solve instead of serializing after it. Graph evolution and
+        #: placements are bit-identical to the synchronous loop: only
+        #: WHEN bindings are posted moves (one dispatch window later),
+        #: never what the scheduler computes (tools/soak.py
+        #: --verify-loop-parity asserts this under chaos).
+        self.pipeline = pipeline
+        self.device_resident = device_resident
+        self._pending_bindings: List[Binding] = []
         # service-level gauges (inert singletons when obs is disabled)
         reg = obs_metrics.get_registry()
         self._g_pods = reg.gauge("ksched_live_pods", "pods the service tracks")
@@ -114,6 +128,7 @@ class SchedulerService:
                 max_tasks_per_pu=max_tasks_per_pu,
                 cost_model_factory=MODEL_REGISTRY[cost_model],
                 backend=backend,
+                device_resident=device_resident,
             )
         else:
             # restore path: the scheduler was rebuilt by replaying the
@@ -295,18 +310,9 @@ class SchedulerService:
 
     # -- the main loop ----------------------------------------------------
 
-    def run_once(self, pods) -> int:
-        """One iteration of the reference loop body (:120-187). Returns
-        the number of new bindings pushed."""
-        for pod in pods:
-            self._add_pod(pod)
-        jd = self.job_map.find(self.job_id)
-        if jd is not None:
-            self.scheduler.add_job(jd)
-        t0 = time.perf_counter()
-        self.scheduler.schedule_all_jobs()
-        self.round_latencies_s.append(time.perf_counter() - t0)
-
+    def _collect_bindings(self) -> List[Binding]:
+        """Diff the scheduler's bindings against what was last emitted
+        and translate new/changed ones into pod→node bindings."""
         new_bindings = self.scheduler.get_task_bindings()
         out = []
         for task_id, pu_rid in new_bindings.items():
@@ -320,8 +326,86 @@ class SchedulerService:
                 continue
             out.append(Binding(pod_id=pod_id, node_id=self.machine_to_node[machine_rid]))
         self.old_bindings = dict(new_bindings)
+        return out
+
+    def flush_pending_bindings(self) -> int:
+        """POST the previous pipelined round's bindings. Called inside
+        the next round's dispatch window (so the HTTP round-trips
+        overlap the in-flight solve), by idle sweeps (a quiet channel
+        must not strand the last active round's POSTs), and by
+        run()/save_checkpoint at loop exit so no binding is ever left
+        unposted. A failed POST restores the batch for retry at the
+        next flush point instead of dropping it."""
+        out, self._pending_bindings = self._pending_bindings, []
+        if out:
+            try:
+                with span("bindings_post", n=len(out)):
+                    self.api.assign_bindings(out)
+            except BaseException:
+                self._pending_bindings = out + self._pending_bindings
+                raise
+        return len(out)
+
+    def run_once(self, pods) -> int:
+        """One iteration of the reference loop body (:120-187). Returns
+        the number of new bindings pushed (queued, in pipeline mode)."""
+        for pod in pods:
+            self._add_pod(pod)
+        jd = self.job_map.find(self.job_id)
+        if jd is not None:
+            self.scheduler.add_job(jd)
+        if self.pipeline:
+            return self._run_once_pipelined()
+        t0 = time.perf_counter()
+        self.scheduler.schedule_all_jobs()
+        self.round_latencies_s.append(time.perf_counter() - t0)
+        out = self._collect_bindings()
         if out:
             self.api.assign_bindings(out)
+        return len(out)
+
+    def _run_once_pipelined(self) -> int:
+        """The double-buffered round body: dispatch this round's solve,
+        post the PREVIOUS round's bindings while the device crunches,
+        then synchronize/decode/apply and queue this round's bindings
+        for the next dispatch window. On a rung failure the ladder
+        completes the round synchronously inside finish_scheduling
+        (runtime/degrade.py solve_async/complete), and LadderExhausted
+        propagates to run_round's NOOP backstop exactly as in the
+        synchronous loop."""
+        t0 = time.perf_counter()
+        token = self.scheduler.schedule_all_jobs_async()
+        # overlap window: the in-flight solve hides these POSTs. A
+        # POST failure must not leave the dispatched round in flight
+        # (every later event handler would refuse forever), so the
+        # round is synchronized first and the error re-raised after —
+        # with the batch already restored for retry by flush itself.
+        flush_err = None
+        try:
+            self.flush_pending_bindings()
+        except BaseException as e:  # noqa: BLE001 — re-raised below;
+            # BaseException on purpose: a KeyboardInterrupt landing in
+            # the POST must still let the dispatched round synchronize,
+            # or the in-flight latch wedges every later event handler
+            flush_err = e
+        try:
+            if token is not None:
+                self.scheduler.finish_scheduling()
+            else:
+                self.scheduler.last_timing = RoundTiming()
+        except BaseException as finish_err:
+            # the flush error outranks the finish error (a Ctrl-C in
+            # the POST must not be swallowed by a LadderExhausted that
+            # run_round's NOOP backstop would absorb); the finish
+            # failure rides along as the cause
+            if flush_err is not None:
+                raise flush_err from finish_err
+            raise
+        self.round_latencies_s.append(time.perf_counter() - t0)
+        out = self._collect_bindings()
+        self._pending_bindings.extend(out)
+        if flush_err is not None:
+            raise flush_err
         return len(out)
 
     def run_round(
@@ -382,6 +466,11 @@ class SchedulerService:
         else:
             # no solve ran: keep stale phase timings out of the trace
             self.scheduler.last_timing = RoundTiming()
+            # a quiet channel must not strand the last active round's
+            # deferred POSTs: with no next dispatch window coming, the
+            # idle sweep IS the flush point (pipeline mode only; the
+            # list is always empty otherwise)
+            self.flush_pending_bindings()
         lost: List[int] = []
         failed: List[int] = []
         if self.monitor is not None:
@@ -472,6 +561,9 @@ class SchedulerService:
                 continue
             self.run_round(pods)
             rounds += 1
+        # pipelined loops defer each round's POSTs into the next
+        # dispatch window; the last round's must not be stranded
+        self.flush_pending_bindings()
 
     # -- service checkpoint (scheduler state + the id maps) ----------------
 
@@ -483,6 +575,9 @@ class SchedulerService:
         the same pods against the same nodes."""
         from .runtime.checkpoint import save_scheduler
 
+        # bindings queued for the next pipelined dispatch window would
+        # not survive the restart; post them before snapshotting
+        self.flush_pending_bindings()
         save_scheduler(self.scheduler, path + ".sched")
         state = {
             "version": SERVICE_CHECKPOINT_VERSION,
@@ -509,6 +604,8 @@ class SchedulerService:
         round_deadline_s: float = 0.0,
         flight: Optional[FlightRecorder] = None,
         span_tracer: Optional[SpanTracer] = None,
+        pipeline: bool = False,
+        device_resident: bool = False,
     ) -> "SchedulerService":
         """Rebuild a service from save_checkpoint output: the scheduler
         is replayed through the event API, then the id maps are
@@ -531,6 +628,7 @@ class SchedulerService:
             path + ".sched",
             cost_model_factory=MODEL_REGISTRY[cost_model],
             backend=backend,
+            device_resident=device_resident,
         )
         svc = cls(
             api,
@@ -542,6 +640,8 @@ class SchedulerService:
             round_deadline_s=round_deadline_s,
             flight=flight,
             span_tracer=span_tracer,
+            pipeline=pipeline,
+            device_resident=device_resident,
             _restored=parts,
         )
         svc.job_id = state["job_id"]
@@ -659,6 +759,17 @@ def main(argv=None) -> int:
                     "with this timeout (0 = off); sweeps run every round")
     ap.add_argument("--one-shot", action="store_true",
                     help="exit once the pod queue is drained")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="double-buffered rounds: dispatch the solve, "
+                    "post the previous round's bindings while it is in "
+                    "flight, then synchronize/decode (docs/round_pipeline"
+                    ".md); placements are bit-identical to the "
+                    "synchronous loop")
+    ap.add_argument("--device-resident", action="store_true",
+                    help="keep the flow problem's arrays live on device "
+                    "between rounds: after the first full upload only "
+                    "packed delta records cross the host/device boundary "
+                    "(graph/device_export.DeviceResidentState)")
     # -- observability (ksched_tpu/obs; docs/observability.md) ----------
     ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                     help="serve Prometheus text on /metricsz (+ /healthz, "
@@ -767,6 +878,8 @@ def main(argv=None) -> int:
         tracer=tracer,
         flight=flight,
         span_tracer=span_tracer,
+        pipeline=args.pipeline,
+        device_resident=args.device_resident,
     )
     if args.machine_timeout > 0:
         svc.enable_heartbeats(machine_timeout_s=args.machine_timeout)
@@ -789,6 +902,7 @@ def main(argv=None) -> int:
             # flight ring, service gauges) — one-shot must not produce
             # empty --round-trace/--flight-dir artifacts
             bound = svc.run_round(pods) if pods else 0
+            svc.flush_pending_bindings()  # pipelined one-shot: post now
             lat = svc.round_latencies_s[-1] * 1e3 if svc.round_latencies_s else 0.0
             print(
                 f"scheduled {bound}/{len(pods)} pods in {lat:.2f}ms "

@@ -12,12 +12,27 @@ mode.
 
 Arrays are padded to power-of-two extents so repeated jit solves reuse
 the same compiled executable as the cluster grows (XLA static shapes).
+
+Two consumers read the per-round mutations:
+
+- ``problem()`` materializes the lower-bound-folded host FlowProblem,
+  rebuilding only the array groups a journal entry actually touched
+  since the last materialize (clean rounds return the cached object);
+- ``DeviceResidentState`` mirrors the folded arrays as PERSISTENT
+  device buffers: the round's dirty slots/nodes are packed on host
+  into flat int32 delta records and applied by ONE jit'd scatter
+  (`delta_apply_fn`), so after the initial full upload only
+  delta-sized records cross the host/device boundary. The mirror is
+  rebuilt only when a pow2 bucket grows or `full_build` reassigns the
+  slot table — the recompile/reupload boundary the reference pays as
+  a full DIMACS re-export.
 """
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -68,24 +83,74 @@ class DeviceGraphState:
         self.cap: Optional[np.ndarray] = None
         self.low: Optional[np.ndarray] = None
         self.cost: Optional[np.ndarray] = None
+        #: per-node lower-bound fold contribution, maintained
+        #: incrementally as arc lows change: folded excess ==
+        #: ``excess + fold`` (replaces the O(M) scatter fold the old
+        #: problem() ran every round)
+        self.fold: Optional[np.ndarray] = None
         self._arc_slot: Dict[Tuple[int, int], int] = {}
         self._free_slots: List[int] = []
         self._num_slots = 0
         self.num_nodes = 0
         self.generation = 0  # bumped when padded extents change (recompile signal)
+        #: bumped by full_build only: the slot table was reassigned, so
+        #: any device mirror of the arc arrays is wholesale invalid
+        #: (growth keeps slots stable and is signaled by n_cap/m_cap)
+        self.rebuild_count = 0
+        # -- mutation tracking ------------------------------------------
+        # Two consumers, two mechanisms: the problem() cache needs only
+        # "did anything in this group change" booleans; the device-
+        # resident mirror needs the exact touched slots/nodes to pack
+        # delta records from. drain_dirty() empties the sets without
+        # touching the cache flags, and vice versa.
+        self._dirty_slots: Set[int] = set()
+        self._dirty_nodes: Set[int] = set()
+        self._cache: Optional[FlowProblem] = None
+        self._cache_nodes_ok = False
+        self._cache_arcs_ok = False
+
+    # -- mutation bookkeeping ---------------------------------------------
+
+    def _touch_slot(self, slot: int) -> None:
+        self._dirty_slots.add(slot)
+        self._cache_arcs_ok = False
+
+    def _touch_node(self, node: int) -> None:
+        self._dirty_nodes.add(node)
+        self._cache_nodes_ok = False
+
+    def _reset_tracking(self) -> None:
+        """After a full (re)build every consumer must resync from the
+        arrays wholesale; per-entry dirt from the build is noise."""
+        self._dirty_slots.clear()
+        self._dirty_nodes.clear()
+        self._cache = None
+        self._cache_nodes_ok = False
+        self._cache_arcs_ok = False
+
+    def drain_dirty(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The slots/nodes touched since the last drain, sorted (set
+        order is not deterministic; packed records must be), and clear
+        them. Consumed by DeviceResidentState.refresh()."""
+        slots = np.sort(np.fromiter(self._dirty_slots, np.int32, len(self._dirty_slots)))
+        nodes = np.sort(np.fromiter(self._dirty_nodes, np.int32, len(self._dirty_nodes)))
+        self._dirty_slots.clear()
+        self._dirty_nodes.clear()
+        return slots, nodes
 
     # -- construction -----------------------------------------------------
 
     def _alloc(self, n: int, m: int) -> None:
         self.n_cap = max(next_pow2(n), 16)
         self.m_cap = max(next_pow2(m), 16)
-        self.excess = np.zeros(self.n_cap, dtype=np.int64)
+        self.excess = np.zeros(self.n_cap, dtype=np.int64)  # kschedlint: host-only (host graph arrays; the device mirror is int32)
         self.node_type = np.full(self.n_cap, -1, dtype=np.int8)
         self.src = np.zeros(self.m_cap, dtype=np.int32)
         self.dst = np.zeros(self.m_cap, dtype=np.int32)
         self.cap = np.zeros(self.m_cap, dtype=np.int32)
         self.low = np.zeros(self.m_cap, dtype=np.int32)
         self.cost = np.zeros(self.m_cap, dtype=np.int32)
+        self.fold = np.zeros(self.n_cap, dtype=np.int64)  # kschedlint: host-only (host graph arrays; the device mirror is int32)
         self.generation += 1
 
     def full_build(self, graph: FlowGraph) -> None:
@@ -101,6 +166,8 @@ class DeviceGraphState:
             self.node_type[node.id] = int(node.type)
         for arc in graph.arcs():
             self._set_arc(arc.src, arc.dst, arc.cap_lower, arc.cap_upper, arc.cost)
+        self.rebuild_count += 1  # slot table reassigned: device mirrors resync
+        self._reset_tracking()
 
     # -- incremental updates ----------------------------------------------
 
@@ -108,12 +175,17 @@ class DeviceGraphState:
         new_cap = next_pow2(need)
         if new_cap <= self.n_cap:
             return
-        self.excess = np.concatenate([self.excess, np.zeros(new_cap - self.n_cap, np.int64)])
+        self.excess = np.concatenate([self.excess, np.zeros(new_cap - self.n_cap, np.int64)])  # kschedlint: host-only (host graph arrays; the device mirror is int32)
         self.node_type = np.concatenate(
             [self.node_type, np.full(new_cap - self.n_cap, -1, np.int8)]
         )
+        self.fold = np.concatenate([self.fold, np.zeros(new_cap - self.n_cap, np.int64)])  # kschedlint: host-only (host graph arrays; the device mirror is int32)
         self.n_cap = new_cap
         self.generation += 1
+        # shapes changed: every cached materialization is stale
+        self._cache = None
+        self._cache_nodes_ok = False
+        self._cache_arcs_ok = False
 
     def _grow_arcs(self, need: int) -> None:
         new_cap = next_pow2(need)
@@ -125,6 +197,9 @@ class DeviceGraphState:
             setattr(self, name, np.concatenate([arr, np.zeros(pad, arr.dtype)]))
         self.m_cap = new_cap
         self.generation += 1
+        self._cache = None
+        self._cache_nodes_ok = False
+        self._cache_arcs_ok = False
 
     def _take_slot(self) -> int:
         if self._free_slots:
@@ -137,6 +212,7 @@ class DeviceGraphState:
     def _set_arc(self, src: int, dst: int, low: int, cap: int, cost: int) -> None:
         key = (src, dst)
         slot = self._arc_slot.get(key)
+        low0 = int(self.low[slot]) if slot is not None else 0
         if cap == 0 and low == 0:
             if slot is not None:
                 self.cap[slot] = 0
@@ -146,15 +222,29 @@ class DeviceGraphState:
                 self.dst[slot] = 0
                 del self._arc_slot[key]
                 self._free_slots.append(slot)
+                self._touch_slot(slot)
+                if low0:
+                    self.fold[src] += low0
+                    self.fold[dst] -= low0
+                    self._touch_node(src)
+                    self._touch_node(dst)
             return
         if slot is None:
             slot = self._take_slot()
             self._arc_slot[key] = slot
+        if low != low0:
+            # fold delta: an arc (src, dst) with lower bound L
+            # contributes -L to src's folded excess and +L to dst's
+            self.fold[src] += low0 - low
+            self.fold[dst] += low - low0
+            self._touch_node(src)
+            self._touch_node(dst)
         self.src[slot] = src
         self.dst[slot] = dst
         self.cap[slot] = cap
         self.low[slot] = low
         self.cost[slot] = cost
+        self._touch_slot(slot)
 
     def apply_changes(self, changes: List[Change]) -> None:
         for ch in changes:
@@ -163,9 +253,11 @@ class DeviceGraphState:
                 self.excess[ch.node_id] = ch.excess
                 self.node_type[ch.node_id] = int(ch.node_type)
                 self.num_nodes = max(self.num_nodes, ch.node_id + 1)
+                self._touch_node(ch.node_id)
             elif isinstance(ch, RemoveNodeChange):
                 self.excess[ch.node_id] = 0
                 self.node_type[ch.node_id] = -1
+                self._touch_node(ch.node_id)
             elif isinstance(ch, (NewArcChange, ChangeArcChange)):
                 self._set_arc(ch.src, ch.dst, ch.cap_lower, ch.cap_upper, ch.cost)
             else:  # pragma: no cover
@@ -173,35 +265,50 @@ class DeviceGraphState:
 
     def set_excess(self, node_id: int, excess: int) -> None:
         """Sink-excess bookkeeping happens outside the journal in the
-        reference (graph_manager.go:636-640); mirror of that path."""
-        self.excess[node_id] = excess
+        reference (graph_manager.go:636-640); mirror of that path. A
+        no-op write stays invisible to the dirty tracking, so the
+        every-round sink sync does not invalidate a clean cache."""
+        if int(self.excess[node_id]) != excess:
+            self.excess[node_id] = excess
+            self._touch_node(node_id)
 
     # -- solver view ------------------------------------------------------
 
     def problem(self) -> FlowProblem:
         """Materialize the lower-bound-folded FlowProblem view.
 
-        Copies the arrays (cheap at these sizes) so a solver can run while
-        further host mutations accumulate.
+        Copies the arrays (never aliases them) so a solver can keep its
+        snapshot while further host mutations accumulate — but only the
+        array GROUPS a journal entry touched since the last materialize
+        are re-copied/refolded: the node side (excess, node_type) and
+        the arc side (src/dst/cap/cost/flow_offset) invalidate
+        independently, and a mutation-free round returns the cached
+        FlowProblem outright. The lower-bound fold is the incrementally
+        maintained ``fold`` array (one vector add), not a scatter pass.
         """
+        cache = self._cache
+        if cache is not None and self._cache_nodes_ok and self._cache_arcs_ok:
+            return cache
         m = self.m_cap
-        excess = self.excess.copy()
-        cap = self.cap[:m].astype(np.int32).copy()
-        low = self.low[:m]
-        cost = self.cost[:m].copy()
-        src = self.src[:m].copy()
-        dst = self.dst[:m].copy()
-        flow_offset = low.astype(np.int32).copy()
-        has_low = low > 0
-        if has_low.any():
-            idx = np.nonzero(has_low)[0]
-            np.subtract.at(excess, src[idx], low[idx].astype(np.int64))
-            np.add.at(excess, dst[idx], low[idx].astype(np.int64))
-            cap[idx] -= low[idx]
-        return FlowProblem(
+        if cache is not None and self._cache_arcs_ok:
+            src, dst, cap = cache.src, cache.dst, cache.cap
+            cost, flow_offset = cache.cost, cache.flow_offset
+        else:
+            low = self.low[:m]
+            src = self.src[:m].copy()
+            dst = self.dst[:m].copy()
+            cap = self.cap[:m] - low  # folded residual bound (new array)
+            cost = self.cost[:m].copy()
+            flow_offset = low.astype(np.int32)
+        if cache is not None and self._cache_nodes_ok:
+            excess, node_type = cache.excess, cache.node_type
+        else:
+            excess = self.excess + self.fold  # folded supply (new array)
+            node_type = self.node_type.copy()
+        self._cache = FlowProblem(
             num_nodes=self.n_cap,
             excess=excess,
-            node_type=self.node_type.copy(),
+            node_type=node_type,
             src=src,
             dst=dst,
             cap=cap,
@@ -209,3 +316,392 @@ class DeviceGraphState:
             flow_offset=flow_offset,
             num_arcs=self._num_slots,
         )
+        self._cache_nodes_ok = True
+        self._cache_arcs_ok = True
+        return self._cache
+
+
+# ---------------------------------------------------------------------------
+# Device-resident mirror: persistent buffers + packed-record delta scatter
+# ---------------------------------------------------------------------------
+
+#: int32 columns of one packed arc delta record:
+#: (slot, src, dst, folded cap, cost). flow_offset stays host-only —
+#: no solver reads it on device (decode adds it back on host), so
+#: shipping it would pad every record by a sixth for nothing.
+ARC_RECORD_COLS = 5
+#: int32 columns of one packed node delta record: (node, folded excess)
+NODE_RECORD_COLS = 2
+#: smallest padded record count — one compiled scatter program per pow2
+#: record bucket, so tiny deltas share one executable
+MIN_RECORD_BUCKET = 8
+
+
+def pad_record_count(k: int) -> int:
+    """Pow2 bucket for a delta-record count (>= 1 so an empty delta
+    still has a well-formed — idempotent — record to ship)."""
+    return max(next_pow2(max(k, 1)), MIN_RECORD_BUCKET)
+
+
+_DELTA_APPLY = None
+
+
+def delta_apply_fn():
+    """The ONE jit'd scatter program of the solver stack: applies a
+    round's packed delta records to the persistent device buffers.
+
+    TPU serializes scatters, which is why every solver program is
+    scatter-free (the zero-scatter jaxpr contract) — but the delta
+    apply is O(records), not O(graph), and runs once per round, so a
+    serialized scatter of ~churn-sized records is exactly the right
+    tool. The jaxpr contracts grant this program a SCOPED exemption
+    from the zero-scatter rule and pin its pow2-bucket hash stability
+    (analysis/jaxpr_contracts.py).
+
+    Records are pow2-padded by REPEATING a real record (or, for an
+    empty delta, re-writing slot/node 0 with its current values):
+    duplicate scatter updates carry identical values, so the result is
+    deterministic regardless of XLA's scatter ordering.
+    """
+    global _DELTA_APPLY
+    if _DELTA_APPLY is None:
+        import jax
+
+        # excess/cap/cost are DONATED: XLA scatters into the existing
+        # buffers instead of copying the whole mirror first (measured
+        # 498 -> 8.7 us/apply at 256k rows on CPU XLA; donation is
+        # honored on CPU and TPU alike). src/dst are NOT donated — the
+        # pre-delta endpoint buffers stay alive as the warm-flow masks
+        # (device_warm_flow_fn) and the solvers' last-solve endpoint
+        # handles; donating them would tear the buffers out from under
+        # those references.
+        @functools.partial(jax.jit, donate_argnums=(0, 3, 4))
+        def _apply_delta(excess, src, dst, cap, cost, arc_rec, node_rec):
+            nid = node_rec[:, 0]
+            excess = excess.at[nid].set(node_rec[:, 1])
+            slot = arc_rec[:, 0]
+            src = src.at[slot].set(arc_rec[:, 1])
+            dst = dst.at[slot].set(arc_rec[:, 2])
+            cap = cap.at[slot].set(arc_rec[:, 3])
+            cost = cost.at[slot].set(arc_rec[:, 4])
+            return excess, src, dst, cap, cost
+
+        _DELTA_APPLY = _apply_delta
+    return _DELTA_APPLY
+
+
+_WARM_FLOW = None
+
+
+def device_warm_flow_fn():
+    """Scatter-free warm-flow carry: the previous round's device flow,
+    kept where the arc endpoints are unchanged (compared against the
+    PRE-delta endpoint buffers, which jax's immutability keeps alive
+    for free) and clipped to the new capacities. Bit-identical to the
+    host path's ``np.where(same, minimum(prev, cap), 0)``, so a
+    device-resident loop decodes the same placements as a host loop.
+    """
+    global _WARM_FLOW
+    if _WARM_FLOW is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _warm_flow(prev_flow, src_prev, dst_prev, src, dst, cap):
+            same = (src_prev == src) & (dst_prev == dst)
+            return jnp.where(same, jnp.minimum(prev_flow, cap), jnp.int32(0))
+
+        _WARM_FLOW = _warm_flow
+    return _WARM_FLOW
+
+
+_SCALE_COST = None
+
+
+def _scale_cost_fn():
+    global _SCALE_COST
+    if _SCALE_COST is None:
+        import jax
+
+        @jax.jit
+        def _scale(cost, n):
+            return cost * n
+
+        _SCALE_COST = _scale
+    return _SCALE_COST
+
+
+@dataclass
+class DeviceResidentProblem(FlowProblem):
+    """A FlowProblem whose folded arrays ALSO live as persistent device
+    buffers. The host arrays stay populated (decode, the cpu_ref/native
+    ladder rungs, and the objective math read them), so every existing
+    consumer keeps working; device-aware solvers read the ``d_*``
+    handles instead of re-uploading.
+
+    The warm-flow masks deliberately compare against endpoint buffers
+    each solver captured at its own last SUCCESSFUL solve (not this
+    refresh's pre-delta buffers): a failed/degraded round still
+    refreshes the mirror, and masking against its endpoints would miss
+    changes from the round the solver never saw — see
+    ``resident_solver_inputs``.
+    """
+
+    d_excess: object = None  # jax int32[n_cap] folded supply
+    d_src: object = None  # jax int32[m_cap]
+    d_dst: object = None  # jax int32[m_cap]
+    d_cap: object = None  # jax int32[m_cap] folded residual bound
+    d_cost: object = None  # jax int32[m_cap] UNSCALED costs
+    resident: object = None  # owning DeviceResidentState
+    version: int = 0
+
+    def device_scaled_cost(self):
+        """Costs pre-scaled by the node count (the general-graph
+        solvers' exactness convention), computed on device once per
+        refresh and cached on the owning resident state."""
+        return self.resident.scaled_cost(self)
+
+
+def resident_solver_inputs(problem, prev_flow, prev_src, prev_dst, warm_start):
+    """The shared device-resident solve prologue for the general-graph
+    backends (jax/ell/mega): the dispatch args read straight from the
+    persistent buffers, and the warm flow is derived ON DEVICE from the
+    solver's previous flow, masked against the endpoint buffers the
+    solver captured at its last successful solve. Returns
+    ``(dev_args, flow0, warm)`` where dev_args is
+    (cap, scaled cost, supply). One implementation so the warm-gate
+    rule can never silently diverge between backends."""
+    import jax.numpy as jnp
+
+    m = problem.d_cap.shape[0]
+    dev_args = (
+        problem.d_cap,
+        problem.device_scaled_cost(),
+        problem.d_excess,
+    )
+    warm = (
+        warm_start
+        and prev_flow is not None
+        and prev_flow.shape[0] == m
+        and prev_src is not None
+        and prev_src.shape[0] == m
+    )
+    if warm:
+        flow0 = device_warm_flow_fn()(
+            prev_flow, prev_src, prev_dst,
+            problem.d_src, problem.d_dst, problem.d_cap,
+        )
+    else:
+        flow0 = jnp.zeros(m, jnp.int32)
+    return dev_args, flow0, warm
+
+
+class DeviceResidentState:
+    """Persistent device mirror of a DeviceGraphState's folded problem
+    arrays.
+
+    ``refresh()`` (once per round, after the journal is applied on
+    host) packs the touched slots/nodes into flat int32 records, ships
+    ONLY those bytes, and applies them with the one jit'd scatter. The
+    mirror is rebuilt wholesale only when:
+
+    - ``full_build`` reassigned the slot table (rebuild_count moved),
+    - the arc pow2 bucket grew (m_cap changed — slot values survive but
+      the buffer shape is stale), or
+    - the node pow2 bucket grew (n_cap; node side only — the arc
+      buffers and the warm-flow geometry survive, as they do on host).
+
+    ``last_upload_bytes``/``last_upload_kind`` expose the EXACT nbytes
+    of what crossed the host→device boundary this refresh — the
+    devprof h2d accounting reads them instead of estimating from
+    ChangeStats.
+    """
+
+    def __init__(self, state: DeviceGraphState) -> None:
+        self.state = state
+        self.d_excess = None
+        self.d_src = None
+        self.d_dst = None
+        self.d_cap = None
+        self.d_cost = None
+        self._rebuild_count = -1
+        self._n_cap = -1
+        self._m_cap = -1
+        self.version = 0
+        self.last_upload_bytes = 0
+        self.last_upload_kind = "full_build"
+        self.last_arc_records = 0
+        self.last_node_records = 0
+        self._scaled = None  # (version, jax scaled-cost buffer)
+
+    # -- packing -----------------------------------------------------------
+
+    def _pack_arcs(self, slots: np.ndarray) -> np.ndarray:
+        st = self.state
+        ka = len(slots)
+        rec = np.zeros((pad_record_count(ka), ARC_RECORD_COLS), np.int32)
+        if ka:
+            low = st.low[slots]
+            rec[:ka, 0] = slots
+            rec[:ka, 1] = st.src[slots]
+            rec[:ka, 2] = st.dst[slots]
+            rec[:ka, 3] = st.cap[slots] - low
+            rec[:ka, 4] = st.cost[slots]
+            rec[ka:] = rec[0]  # idempotent pad: repeat a real record
+        else:
+            rec[:, 1] = st.src[0]
+            rec[:, 2] = st.dst[0]
+            rec[:, 3] = st.cap[0] - st.low[0]
+            rec[:, 4] = st.cost[0]
+        return rec
+
+    def _pack_nodes(self, nodes: np.ndarray) -> np.ndarray:
+        st = self.state
+        kn = len(nodes)
+        rec = np.zeros((pad_record_count(kn), NODE_RECORD_COLS), np.int32)
+        folded0 = st.excess[nodes] + st.fold[nodes] if kn else None
+        if kn:
+            rec[:kn, 0] = nodes
+            rec[:kn, 1] = folded0.astype(np.int32)
+            rec[kn:] = rec[0]
+        else:
+            rec[:, 1] = np.int32(int(st.excess[0]) + int(st.fold[0]))
+        return rec
+
+    # -- refresh -----------------------------------------------------------
+
+    def _full_upload(self, problem: FlowProblem, arcs_too: bool) -> int:
+        import jax.numpy as jnp
+
+        nbytes = 0
+        self.d_excess = jnp.asarray(problem.excess.astype(np.int32))
+        nbytes += self.d_excess.nbytes
+        if arcs_too:
+            self.d_src = jnp.asarray(problem.src)
+            self.d_dst = jnp.asarray(problem.dst)
+            self.d_cap = jnp.asarray(problem.cap)
+            self.d_cost = jnp.asarray(problem.cost.astype(np.int32))
+            nbytes += (
+                self.d_src.nbytes + self.d_dst.nbytes
+                + self.d_cap.nbytes + self.d_cost.nbytes
+            )
+        return nbytes
+
+    def refresh(self) -> DeviceResidentProblem:
+        """Sync the mirror with the host state and return the
+        device-resident problem handle for this round's solve."""
+        from ..obs.spans import span
+
+        st = self.state
+        problem = st.problem()
+        slots, nodes = st.drain_dirty()
+        rebuilt = self._rebuild_count != st.rebuild_count
+        arcs_stale = rebuilt or self._m_cap != st.m_cap or self.d_src is None
+        nodes_stale = rebuilt or self._n_cap != st.n_cap or self.d_excess is None
+        if arcs_stale or nodes_stale:
+            with span(
+                "delta_upload",
+                kind="full_build" if arcs_stale else "node_rebuild",
+            ):
+                nbytes = self._full_upload(problem, arcs_too=arcs_stale)
+                if not arcs_stale:
+                    # node bucket grew, arc side still delta-sized: the
+                    # endpoint geometry survives, so warm flow does too
+                    arc_rec = self._pack_arcs(slots)
+                    self._scatter_arcs(arc_rec)
+                    nbytes += arc_rec.nbytes
+            self.last_upload_kind = "full_build"
+            self.last_upload_bytes = nbytes
+            self.last_arc_records = len(slots)
+            self.last_node_records = len(nodes)
+        else:
+            with span("delta_pack", arcs=len(slots), nodes=len(nodes)):
+                arc_rec = self._pack_arcs(slots)
+                node_rec = self._pack_nodes(nodes)
+            with span(
+                "delta_upload", bytes=arc_rec.nbytes + node_rec.nbytes
+            ):
+                import jax.numpy as jnp
+
+                apply_delta = delta_apply_fn()
+                (
+                    self.d_excess, self.d_src, self.d_dst,
+                    self.d_cap, self.d_cost,
+                ) = apply_delta(
+                    self.d_excess, self.d_src, self.d_dst,
+                    self.d_cap, self.d_cost,
+                    jnp.asarray(arc_rec), jnp.asarray(node_rec),
+                )
+            self.last_upload_kind = "delta"
+            self.last_upload_bytes = arc_rec.nbytes + node_rec.nbytes
+            self.last_arc_records = len(slots)
+            self.last_node_records = len(nodes)
+        self._rebuild_count = st.rebuild_count
+        self._n_cap = st.n_cap
+        self._m_cap = st.m_cap
+        self.version += 1
+        return DeviceResidentProblem(
+            num_nodes=problem.num_nodes,
+            excess=problem.excess,
+            node_type=problem.node_type,
+            src=problem.src,
+            dst=problem.dst,
+            cap=problem.cap,
+            cost=problem.cost,
+            flow_offset=problem.flow_offset,
+            num_arcs=problem.num_arcs,
+            d_excess=self.d_excess,
+            d_src=self.d_src,
+            d_dst=self.d_dst,
+            d_cap=self.d_cap,
+            d_cost=self.d_cost,
+            resident=self,
+            version=self.version,
+        )
+
+    def _scatter_arcs(self, arc_rec: np.ndarray) -> None:
+        """Arc-side-only scatter (node-rebuild refreshes): reuses the
+        one delta program with an empty — idempotent — node record."""
+        import jax.numpy as jnp
+
+        node_rec = self._pack_nodes(np.zeros(0, np.int32))
+        apply_delta = delta_apply_fn()
+        (
+            self.d_excess, self.d_src, self.d_dst, self.d_cap, self.d_cost,
+        ) = apply_delta(
+            self.d_excess, self.d_src, self.d_dst, self.d_cap, self.d_cost,
+            jnp.asarray(arc_rec), jnp.asarray(node_rec),
+        )
+
+    def scaled_cost(self, problem: DeviceResidentProblem):
+        """d_cost * num_nodes, computed on device, cached per refresh."""
+        if self._scaled is None or self._scaled[0] != problem.version:
+            import jax.numpy as jnp
+
+            scaled = _scale_cost_fn()(
+                problem.d_cost, jnp.int32(problem.num_nodes)
+            )
+            self._scaled = (problem.version, scaled)
+        return self._scaled[1]
+
+    def parity_check(self) -> None:
+        """Assert the device mirror equals the host folded view
+        bit-for-bit (fetches the buffers; test/debug only)."""
+        problem = self.state.problem()
+        pairs = (
+            (self.d_excess, problem.excess.astype(np.int32)),
+            (self.d_src, problem.src),
+            (self.d_dst, problem.dst),
+            (self.d_cap, problem.cap),
+            (self.d_cost, problem.cost.astype(np.int32)),
+        )
+        names = ("excess", "src", "dst", "cap", "cost")
+        for name, (dev, host) in zip(names, pairs):
+            got = np.asarray(dev)
+            if not np.array_equal(got, host):
+                bad = np.nonzero(got != host)[0][:8]
+                raise AssertionError(
+                    f"device mirror diverged from host {name} at rows "
+                    f"{bad.tolist()}: device={got[bad].tolist()} "
+                    f"host={host[bad].tolist()}"
+                )

@@ -109,8 +109,20 @@ class DeviceProfiler:
 
     # -- export accounting -------------------------------------------------
 
-    def note_export(self, problem, full: bool, stats=None, changes=None) -> None:
-        if full:
+    def note_export(
+        self, problem, full: bool, stats=None, changes=None,
+        exact_bytes: Optional[int] = None,
+    ) -> None:
+        """``exact_bytes`` is the measured host→device byte count from
+        the device-resident export path (packed delta-record nbytes, or
+        the rebuild upload) — exact accounting, preferred over every
+        estimate below. The non-resident paths re-upload full arrays
+        but their *delta-relevant* traffic is estimated from the
+        journal (``journal_nbytes``) or, lacking one, ChangeStats."""
+        if exact_bytes is not None:
+            kind = "full_build" if full else "delta"
+            self.h2d_bytes.labels(kind=kind).inc(exact_bytes)
+        elif full:
             self.h2d_bytes.labels(kind="full_build").inc(problem_nbytes(problem))
         elif changes is not None:
             self.h2d_bytes.labels(kind="delta").inc(journal_nbytes(changes))

@@ -60,6 +60,7 @@ def restore_scheduler(
     path: str,
     cost_model_factory=None,
     backend=None,
+    device_resident: bool = False,
 ) -> Tuple[FlowScheduler, ResourceMap, JobMap, TaskMap]:
     """Rebuild a scheduler from a checkpoint by replaying the event API.
 
@@ -100,6 +101,7 @@ def restore_scheduler(
         max_tasks_per_pu=state["max_tasks_per_pu"],
         cost_model_factory=cost_model_factory,
         backend=backend,
+        device_resident=device_resident,
     )
     # Each machine subtree under the coordinator goes through the normal
     # registration path (the constructor already registered the root).

@@ -118,55 +118,131 @@ class DegradingSolver(FlowSolver):
 
     # -- FlowSolver --------------------------------------------------------
 
-    def solve(self, problem: FlowProblem) -> FlowResult:
-        from ..obs import soltel
-
+    def _begin_solve(self) -> List[Tuple[str, BaseException]]:
         self.last_degradations = 0
         self.last_rung = -1
         self.last_rung_name = None
         self.last_failure_reasons = []
-        failures: List[Tuple[str, BaseException]] = []
-        for i, (name, _) in enumerate(self._rungs):
-            p = problem
+        self.last_telemetry = None
+        return []
+
+    def _rung_problem(self, i: int, name: str, problem: FlowProblem) -> FlowProblem:
+        """Apply this rung's scheduled chaos fault (if any) — raising
+        for exception/nonconvergence faults, poisoning for nan_cost."""
+        fault = self.injector.solver_fault(i) if self.injector else None
+        if fault == "exception":
+            raise ChaosBackendError(f"chaos: injected backend exception ({name})")
+        if fault == "nonconverge":
+            raise RuntimeError(f"chaos: forced non-convergence ({name})")
+        if fault == "nan_cost":
+            return poison_costs(problem)
+        return problem
+
+    def _note_rung_failure(
+        self,
+        i: int,
+        name: str,
+        e: BaseException,
+        failures: List[Tuple[str, BaseException]],
+    ) -> None:
+        from ..obs import soltel
+
+        failures.append((name, e))
+        # structured reason instead of a bare timeout: the stall
+        # detector's verdict (+ the final supersteps of telemetry)
+        # lands in the soltel ring that every flight dump embeds, and
+        # rides LadderExhausted.reasons
+        reason = soltel.failure_reason(name, e)
+        self.last_failure_reasons.append(
+            soltel.note_stall(reason, getattr(e, "telemetry", None))
+        )
+        self.degradations_total += 1
+        self.last_degradations += 1
+        self._m_degradations.labels(rung=name).inc()
+        nxt = self._rungs[i + 1][0] if i + 1 < len(self._rungs) else None
+        warnings.warn(
+            f"solver rung {name!r} failed "
+            f"({reason.get('kind', 'error')}: {e}); "
+            + (f"degrading to {nxt!r}" if nxt else "ladder exhausted"),
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+    def _finish_rung(self, i: int, name: str) -> None:
+        self.last_rung = i
+        self.last_rung_name = name
+        self._m_rung.set(i)
+
+    def _solve_from(
+        self,
+        start: int,
+        problem: FlowProblem,
+        failures: List[Tuple[str, BaseException]],
+    ) -> FlowResult:
+        for i in range(start, len(self._rungs)):
+            name = self._rungs[i][0]
             try:
-                fault = self.injector.solver_fault(i) if self.injector else None
-                if fault == "exception":
-                    raise ChaosBackendError(f"chaos: injected backend exception ({name})")
-                if fault == "nonconverge":
-                    raise RuntimeError(f"chaos: forced non-convergence ({name})")
-                if fault == "nan_cost":
-                    p = poison_costs(problem)
+                p = self._rung_problem(i, name, problem)
                 # solve_traced: each rung attempt — including a failing
                 # one — is a nested backend_solve span in the trace
                 result = self._backend(i).solve_traced(p)
             except DEGRADABLE_ERRORS as e:
-                failures.append((name, e))
-                # structured reason instead of a bare timeout: the stall
-                # detector's verdict (+ the final supersteps of
-                # telemetry) lands in the soltel ring that every flight
-                # dump embeds, and rides LadderExhausted.reasons
-                reason = soltel.failure_reason(name, e)
-                self.last_failure_reasons.append(
-                    soltel.note_stall(reason, getattr(e, "telemetry", None))
-                )
-                self.degradations_total += 1
-                self.last_degradations += 1
-                self._m_degradations.labels(rung=name).inc()
-                nxt = self._rungs[i + 1][0] if i + 1 < len(self._rungs) else None
-                warnings.warn(
-                    f"solver rung {name!r} failed "
-                    f"({reason.get('kind', 'error')}: {e}); "
-                    + (f"degrading to {nxt!r}" if nxt else "ladder exhausted"),
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
+                self._note_rung_failure(i, name, e, failures)
                 continue
-            self.last_rung = i
-            self.last_rung_name = name
-            self._m_rung.set(i)
+            self._finish_rung(i, name)
             return result
         self._m_exhausted.inc()
         raise LadderExhausted(failures, reasons=list(self.last_failure_reasons))
+
+    def solve(self, problem: FlowProblem) -> FlowResult:
+        return self._solve_from(0, problem, self._begin_solve())
+
+    # -- pipelined dispatch ------------------------------------------------
+
+    def solve_async(self, problem: FlowProblem):
+        """Dispatch the CONFIGURED rung without synchronizing, so a
+        pipelined round can overlap host work with the in-flight solve.
+        Any rung failure — at dispatch or at complete() — degrades
+        through the remaining rungs SYNCHRONOUSLY inside complete():
+        the pipelined loop falls back to the synchronous path on a rung
+        failure rather than attempting to re-pipeline a degraded round.
+        Fault draws, degradation counters, and the failure-reason ring
+        behave exactly as in solve() (same per-round injector plan,
+        same rung order)."""
+        failures = self._begin_solve()
+        name = self._rungs[0][0]
+        try:
+            p = self._rung_problem(0, name, problem)
+            b = self._backend(0)
+            if hasattr(b, "solve_async"):
+                return (problem, "pending", b.solve_async(p), failures)
+            return (problem, "done", b.solve_traced(p), failures)
+        except DEGRADABLE_ERRORS as e:
+            self._note_rung_failure(0, name, e, failures)
+            return (problem, "failed", None, failures)
+
+    def complete(self, token) -> FlowResult:
+        """Synchronize a solve_async dispatch; on failure, degrade
+        through the remaining rungs synchronously."""
+        problem, kind, payload, failures = token
+        if kind == "done":
+            self._finish_rung(0, self._rungs[0][0])
+            return payload
+        if kind == "pending":
+            name = self._rungs[0][0]
+            b = self._backend(0)
+            try:
+                result = b.complete(payload)
+            except DEGRADABLE_ERRORS as e:
+                self._note_rung_failure(0, name, e, failures)
+            else:
+                self._finish_rung(0, name)
+                # async completions bypass solve_traced, so the caller
+                # (solver/placement.py) publishes solver-interior
+                # telemetry from last_telemetry — surface the rung's
+                self.last_telemetry = getattr(b, "last_telemetry", None)
+                return result
+        return self._solve_from(1, problem, failures)
 
     def reset(self) -> None:
         # only instantiated rungs carry warm state worth dropping

@@ -68,6 +68,7 @@ class FlowScheduler:
         cost_model_factory=None,
         backend: Optional[FlowSolver] = None,
         preemption: bool = False,
+        device_resident: bool = False,
     ) -> None:
         self.resource_map = resource_map
         self.job_map = job_map
@@ -93,7 +94,11 @@ class FlowScheduler:
             preemption=preemption,
         )
         self.gm.add_resource_topology(root)
-        self.solver = PlacementSolver(self.gm, backend or ReferenceSolver())
+        self.solver = PlacementSolver(
+            self.gm,
+            backend or ReferenceSolver(),
+            device_resident=device_resident,
+        )
 
         self.resource_roots: Set[int] = set()  # ids of registered topology roots
         self._root_rtnds: Dict[int, ResourceTopologyNodeDescriptor] = {}
@@ -180,7 +185,17 @@ class FlowScheduler:
             ]
 
     def handle_task_placement(self, td: TaskDescriptor, rd: ResourceDescriptor) -> None:
-        """Reference: flowscheduler/scheduler.go:212-229."""
+        """Reference: flowscheduler/scheduler.go:212-229.
+
+        Fenced like the other placement-mutating events: an external
+        placement while a pipelined round is in flight would bind a
+        task the dispatched snapshot still maps as schedulable. The
+        internal caller (delta application) runs after the latch
+        clears."""
+        self._check_not_in_flight("handle_task_placement")
+        self._handle_task_placement(td, rd)
+
+    def _handle_task_placement(self, td: TaskDescriptor, rd: ResourceDescriptor) -> None:
         td.scheduled_to_resource = rd.uuid
         self.gm.task_scheduled(td.uid, resource_id_from_string(rd.uuid))
         self._bind_task_to_resource(td, rd)
@@ -210,7 +225,14 @@ class FlowScheduler:
         self._insert_task_into_runnables(job_id_from_string(td.job_id), td.uid)
 
     def handle_task_migration(self, td: TaskDescriptor, rd: ResourceDescriptor) -> None:
-        """Reference: flowscheduler/scheduler.go:248-270."""
+        """Reference: flowscheduler/scheduler.go:248-270. Fenced while
+        a pipelined round is in flight (see handle_task_placement);
+        delta application uses _handle_task_migration after the latch
+        clears."""
+        self._check_not_in_flight("handle_task_migration")
+        self._handle_task_migration(td, rd)
+
+    def _handle_task_migration(self, td: TaskDescriptor, rd: ResourceDescriptor) -> None:
         old_rid = self.task_bindings[td.uid]
         new_rid = resource_id_from_string(rd.uuid)
         # scheduledToResource must be up to date before TaskMigrated
@@ -417,12 +439,12 @@ class FlowScheduler:
                 jd = self.job_map.find(job_id_from_string(td.job_id))
                 if jd.state != JobState.RUNNING:
                     jd.state = JobState.RUNNING
-                self.handle_task_placement(td, rs.descriptor)
+                self._handle_task_placement(td, rs.descriptor)
                 num_scheduled += 1
             elif d.type == DeltaType.PREEMPT:
                 self._evict_task(td, rs.descriptor)
             elif d.type == DeltaType.MIGRATE:
-                self.handle_task_migration(td, rs.descriptor)
+                self._handle_task_migration(td, rs.descriptor)
             elif d.type == DeltaType.NOOP:
                 pass
             else:

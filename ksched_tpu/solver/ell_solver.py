@@ -440,6 +440,11 @@ class EllSolver(FlowSolver):
         self.w_hub = w_hub
         self.telemetry = telemetry
         self._prev: Optional[np.ndarray] = None
+        self._prev_dev = None  # warm flow as a device array (no re-upload)
+        # endpoints at the LAST SUCCESSFUL SOLVE (see jax_solver: the
+        # warm mask must not use a failed round's refresh endpoints)
+        self._prev_src_dev = None
+        self._prev_dst_dev = None
         self._plan: Optional[EllPlan] = None
         self._plan_dev: Optional[tuple] = None
         self.last_supersteps = 0
@@ -447,6 +452,9 @@ class EllSolver(FlowSolver):
 
     def reset(self) -> None:
         self._prev = None
+        self._prev_dev = None
+        self._prev_src_dev = None
+        self._prev_dst_dev = None
 
     def _plan_for(self, src, dst, n) -> tuple:
         plan = self._plan
@@ -472,32 +480,44 @@ class EllSolver(FlowSolver):
         check_finite_costs(problem)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
-        cap = problem.cap.astype(np.int32)
-        supply = problem.excess.astype(np.int32)
         max_cost = int(np.abs(problem.cost).max()) if m else 0
         if max_cost * n >= (1 << 30):
             raise OverflowError(
                 f"scaled costs overflow int32: max|cost|={max_cost} at {n} nodes"
             )
-        cost = problem.cost.astype(np.int32) * np.int32(n)
 
         prev_plan = self._plan
         plan_dev = self._plan_for(src, dst, n)
 
-        flow0 = np.zeros(m, dtype=np.int32)
-        if self.warm_start and self._prev is not None:
-            f_prev = self._prev
-            if len(f_prev) == m and prev_plan is not None and len(prev_plan.src) == m:
-                same = (prev_plan.src == src) & (prev_plan.dst == dst)
-                flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
-
         from ..obs import soltel
 
         tel_cap = soltel.resolve_cap(self.telemetry)
-        dev_args = (jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply))
+        resident = getattr(problem, "d_cap", None) is not None
+        if resident:
+            # device-resident problem handle: persistent buffers in,
+            # device-carried warm flow — no per-round array re-uploads
+            # (see solver/jax_solver.py; same contract)
+            from ..graph.device_export import resident_solver_inputs
+
+            dev_args, flow0_dev, _warm = resident_solver_inputs(
+                problem, self._prev_dev, self._prev_src_dev,
+                self._prev_dst_dev, self.warm_start,
+            )
+        else:
+            cap = problem.cap.astype(np.int32)
+            supply = problem.excess.astype(np.int32)
+            cost = problem.cost.astype(np.int32) * np.int32(n)
+            dev_args = (jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply))
+            flow0 = np.zeros(m, dtype=np.int32)
+            if self.warm_start and self._prev is not None:
+                f_prev = self._prev
+                if len(f_prev) == m and prev_plan is not None and len(prev_plan.src) == m:
+                    same = (prev_plan.src == src) & (prev_plan.dst == dst)
+                    flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
+            flow0_dev = jnp.asarray(flow0)
         fut = _solve_mcmf_ell(
             *dev_args,
-            jnp.asarray(flow0),
+            flow0_dev,
             jnp.asarray(np.int32(1)),
             *plan_dev,
             alpha=self.alpha,
@@ -505,12 +525,12 @@ class EllSolver(FlowSolver):
             telemetry_cap=tel_cap,
         )
         cold = (np.zeros(m, dtype=np.int32), max(1, max_cost * n))
-        return (problem, fut, (dev_args, plan_dev, cold, tel_cap), None)
+        return (problem, fut, (dev_args, plan_dev, cold, tel_cap), resident)
 
     def complete(self, pending) -> FlowResult:
         from ..obs import soltel
 
-        problem, fut, rest, _ = pending
+        problem, fut, rest, resident = pending
         if fut is None:
             self.last_telemetry = None
             return FlowResult(
@@ -550,7 +570,7 @@ class EllSolver(FlowSolver):
             else None
         )
         if bool(p_overflow) or not bool(converged):
-            self._prev = None
+            self.reset()
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
@@ -564,6 +584,9 @@ class EllSolver(FlowSolver):
         flow_np = np.asarray(flow)
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
+            self._prev_dev = flow if resident else None
+            self._prev_src_dev = problem.d_src if resident else None
+            self._prev_dst_dev = problem.d_dst if resident else None
         objective = int(
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         ) + lower_bound_cost(problem)

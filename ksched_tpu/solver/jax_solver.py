@@ -152,15 +152,17 @@ def _seg_min(vals, isstart, node_last, node_nonempty, identity):
 _BIG_D = 1 << 28  # "unreachable" distance sentinel for price tightening
 
 
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap"))
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap", "use_warm_p"))
 def _solve_mcmf(
     cap, cost, supply, flow0, eps_init,
     s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, inv_order,
     node_first, node_last, node_nonempty,
+    warm_p=None,
     alpha: int = 8,
     max_supersteps: int = 50_000,
     tighten_sweeps: int = 32,
     telemetry_cap: int = 0,
+    use_warm_p: bool = False,
 ):
     """telemetry_cap > 0 appends a superstep-indexed int32 telemetry
     ring [telemetry_cap, SOLTEL_WIDTH] to the returned tuple (row
@@ -168,7 +170,17 @@ def _solve_mcmf(
     supersteps always survive. The counters read state each superstep
     already computes — flows are bit-identical on/off, and with cap=0
     this traces the exact pre-telemetry jaxpr (no cost when off;
-    pinned by the jaxpr contracts)."""
+    pinned by the jaxpr contracts).
+
+    use_warm_p=True starts the discharge from the caller-supplied
+    ``warm_p`` potentials (the previous round's device-resident prices)
+    instead of running the tightening pass — the saturate step restores
+    0-optimality w.r.t. ANY price function, so the result is still an
+    exact optimum; only the trajectory (and thus which optimum, under
+    ties) differs. With the defaults (None, False) the traced program
+    is byte-identical to the pre-warm_p jaxpr: warm_p=None contributes
+    no invars and the tighten branch traces exactly as before (the
+    pinned off-hash contracts depend on that)."""
     from ..obs.soltel import SOLTEL_WIDTH
 
     m = cap.shape[0]
@@ -314,7 +326,7 @@ def _solve_mcmf(
 
         return lax.cond(any_active, do_superstep, next_phase, operand=None)
 
-    p0 = tighten(flow0)
+    p0 = warm_p if use_warm_p else tighten(flow0)
     flow1 = saturate(flow0, p0)  # mop up any residual violations
     state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
     if telemetry_cap:
@@ -332,19 +344,50 @@ def _solve_mcmf(
 
 
 class JaxSolver(FlowSolver):
-    """Cost-scaling push-relabel on device, warm-started across rounds."""
+    """Cost-scaling push-relabel on device, warm-started across rounds.
 
-    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True, telemetry: Optional[int] = None):
+    Handed a DeviceResidentProblem (graph/device_export.py), the solve
+    reads the persistent device buffers directly — no device_put of
+    unchanged arrays — and the warm flow is carried BETWEEN rounds as a
+    device array (masked against the pre-delta endpoints by the
+    scatter-free ``device_warm_flow_fn`` program), bit-identical to the
+    host warm path. Node potentials are likewise kept device-resident;
+    with ``warm_potentials=True`` the warm attempt starts from them
+    instead of re-running the tightening pass (an exact solve either
+    way — under cost ties the two trajectories may pick different
+    optima, which is why the default stays False: loop-mode and
+    export-arm parity tests compare placements bit-for-bit)."""
+
+    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True, telemetry: Optional[int] = None, warm_potentials: bool = False, restart_budget: Optional[int] = None):
         from .layered import validate_alpha
 
         self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
+        self.warm_potentials = warm_potentials
+        #: superstep budget for the WARM attempt before escaping to a
+        #: fresh-restart solve (flow0=0, tightened prices, eps=1 — the
+        #: ~10-superstep machine on these graphs) instead of burning
+        #: the full 4096-step attempt-1 budget. None keeps the original
+        #: two-attempt ladder. Measured at 10k×1k/1% churn: warm
+        #: price-war rounds cost 600-3000 supersteps; with a 256-step
+        #: budget they cost ≤ 256 + ~10 (BENCH_PIPELINE_r11.json).
+        self.restart_budget = restart_budget
         #: telemetry ring capacity override; None = the soltel module
         #: default (0 when KSCHED_SOLTEL=0 — telemetry off, identical
         #: traced program), resolved per solve
         self.telemetry = telemetry
         self._prev: Optional[np.ndarray] = None  # previous round's flow
+        self._prev_dev = None  # same flow as a device array (no re-upload)
+        self._prev_p = None  # previous round's potentials, device-resident
+        #: endpoint buffers AT THE LAST SUCCESSFUL SOLVE — the warm
+        #: mask must compare against these, not the pre-delta buffers
+        #: of the latest refresh: a failed/degraded round still
+        #: refreshes the mirror, and masking against its endpoints
+        #: would miss changes from the round the solver never saw
+        #: (the host path gets this via prev_plan's endpoints)
+        self._prev_src_dev = None
+        self._prev_dst_dev = None
         self._plan: Optional[CsrPlan] = None
         self._plan_dev: Optional[tuple] = None
         self.last_supersteps = 0
@@ -352,6 +395,10 @@ class JaxSolver(FlowSolver):
 
     def reset(self) -> None:
         self._prev = None
+        self._prev_dev = None
+        self._prev_p = None
+        self._prev_src_dev = None
+        self._prev_dst_dev = None
 
     def _plan_for(self, src: np.ndarray, dst: np.ndarray, n: int) -> tuple:
         plan = self._plan
@@ -389,8 +436,6 @@ class JaxSolver(FlowSolver):
         check_finite_costs(problem)
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
-        cap = problem.cap.astype(np.int32)
-        supply = problem.excess.astype(np.int32)
 
         # Pre-scale costs by the node count so eps = 1 implies exactness;
         # the scaled range must fit int32 comfortably.
@@ -400,62 +445,125 @@ class JaxSolver(FlowSolver):
                 f"scaled costs overflow int32: max|cost|={max_cost} at {n} nodes; "
                 "rescale cost-model outputs or shrink the graph padding"
             )
-        cost = problem.cost.astype(np.int32) * np.int32(n)
 
         prev_plan = self._plan
         plan_dev = self._plan_for(src, dst, n)
 
-        flow0 = np.zeros(m, dtype=np.int32)
-        if self.warm_start and self._prev is not None:
-            f_prev = self._prev
-            if len(f_prev) == m and prev_plan is not None and len(prev_plan.src) == m:
+        from ..obs import soltel
+
+        tel_cap = soltel.resolve_cap(self.telemetry)
+        resident = getattr(problem, "d_cap", None) is not None
+        if resident:
+            # Device-resident problem: the folded arrays are already on
+            # device (only this round's delta records crossed the
+            # boundary); the warm flow is last round's device output,
+            # masked against the last successful solve's endpoints —
+            # the same values the host mask below computes, without the
+            # flow round-trip.
+            from ..graph.device_export import resident_solver_inputs
+
+            dev_args, flow0_dev, warm = resident_solver_inputs(
+                problem, self._prev_dev, self._prev_src_dev,
+                self._prev_dst_dev, self.warm_start,
+            )
+        else:
+            cap = problem.cap.astype(np.int32)
+            supply = problem.excess.astype(np.int32)
+            cost = problem.cost.astype(np.int32) * np.int32(n)
+            dev_args = (
+                jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply),
+            )
+            warm = (
+                self.warm_start
+                and self._prev is not None
+                and len(self._prev) == m
+                and prev_plan is not None
+                and len(prev_plan.src) == m
+            )
+            flow0 = np.zeros(m, dtype=np.int32)
+            if warm:
                 # Reuse prior flow where the arc endpoints are unchanged;
                 # price tightening inside the solve re-derives consistent
                 # potentials, so flow is the only warm state needed.
                 same = (prev_plan.src == src) & (prev_plan.dst == dst)
-                flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
+                flow0 = np.where(same, np.minimum(self._prev, cap), 0).astype(np.int32)
+            flow0_dev = jnp.asarray(flow0)
 
-        # Attempt 1: warm flow, tightened prices + eps=1 discharge
-        # (cheap, exact, and in practice a handful of supersteps per
-        # delta). Attempt 2: genuinely cold — zero flow and full
-        # cost-scaling — so a poisoned warm state can always recover.
-        # Only attempt 1 is dispatched here; the cold fallback runs
-        # synchronously in complete() if needed (rare).
-        from ..obs import soltel
-
-        tel_cap = soltel.resolve_cap(self.telemetry)
-        dev_args = (
-            jnp.asarray(cap), jnp.asarray(cost), jnp.asarray(supply),
+        # Attempt 1: warm flow, tightened prices (or, with
+        # warm_potentials, the previous round's device-resident prices)
+        # + eps=1 discharge. Attempt 2: genuinely cold — zero flow and
+        # full cost-scaling — so a poisoned warm state can always
+        # recover. Only attempt 1 is dispatched here; the cold fallback
+        # runs synchronously in complete() if needed (rare).
+        warm_p_ok = (
+            self.warm_potentials
+            and warm
+            and self._prev_p is not None
+            and self._prev_p.shape[0] == n
         )
+        attempt1_budget = min(4096, self.max_supersteps)
+        if warm and self.restart_budget is not None:
+            # budgeted warm attempt: a price-war round escapes to the
+            # fresh-restart attempt in complete() instead of burning
+            # the full attempt-1 budget first
+            attempt1_budget = min(attempt1_budget, self.restart_budget)
         fut = _solve_mcmf(
             *dev_args,
-            jnp.asarray(flow0),
+            flow0_dev,
             jnp.asarray(np.int32(1)),
             *plan_dev,
+            warm_p=self._prev_p if warm_p_ok else None,
             alpha=self.alpha,
-            max_supersteps=min(4096, self.max_supersteps),
+            max_supersteps=attempt1_budget,
             telemetry_cap=tel_cap,
+            use_warm_p=warm_p_ok,
         )
         cold = (np.zeros(m, dtype=np.int32), max(1, max_cost * n))
-        return (problem, fut, (dev_args, plan_dev, cold, tel_cap), None)
+        return (problem, fut, (dev_args, plan_dev, cold, tel_cap, warm), resident)
 
     def complete(self, pending) -> FlowResult:
         """Synchronize a solve_async dispatch into a FlowResult."""
         from ..obs import soltel
 
-        problem, fut, rest, _ = pending
+        problem, fut, rest, resident = pending
         if fut is None:
             self.last_telemetry = None
             return FlowResult(
                 flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
-        dev_args, plan_dev, (f0_cold, eps_cold), tel_cap = rest
+        dev_args, plan_dev, (f0_cold, eps_cold), tel_cap, warm = rest
         tel_buf = None
         if tel_cap:
             flow, p, steps, converged, p_overflow, tel_buf = fut
         else:
             flow, p, steps, converged, p_overflow = fut
+        spent = int(steps)  # device work across ALL attempts this solve
+        if (
+            not (bool(converged) and not bool(p_overflow))
+            and warm
+            and self.restart_budget is not None
+        ):
+            # Attempt 1b (restart escape): a warm attempt that blew its
+            # budget re-solves FRESH — zero flow, tightened prices,
+            # eps=1 — the ~10-superstep path on these graphs, instead
+            # of the ~20k-superstep full cost-scaling below. Exact
+            # either way; the cost-scaling attempt remains the backstop
+            # for genuinely hard instances.
+            out = _solve_mcmf(
+                *dev_args,
+                jnp.asarray(f0_cold),
+                jnp.asarray(np.int32(1)),
+                *plan_dev,
+                alpha=self.alpha,
+                max_supersteps=min(4096, self.max_supersteps),
+                telemetry_cap=tel_cap,
+            )
+            if tel_cap:
+                flow, p, steps, converged, p_overflow, tel_buf = out
+            else:
+                flow, p, steps, converged, p_overflow = out
+            spent += int(steps)
         if not (bool(converged) and not bool(p_overflow)):
             out = _solve_mcmf(
                 *dev_args,
@@ -470,7 +578,12 @@ class JaxSolver(FlowSolver):
                 flow, p, steps, converged, p_overflow, tel_buf = out
             else:
                 flow, p, steps, converged, p_overflow = out
-        self.last_supersteps = int(steps)
+            spent += int(steps)
+        # work accounting covers every attempt (a budget-blown warm
+        # attempt's burn included) — the supersteps the DEVICE ran this
+        # round, not just the attempt that won; telemetry decode below
+        # stays attempt-local (the ring indexes the final attempt)
+        self.last_supersteps = spent
         # the telemetry budget is the SOLVER's budget (max_supersteps),
         # not the warm attempt's internal 4096 cap: a warm solve that
         # converges near 4096 steps is escalated to the cold fallback,
@@ -486,7 +599,7 @@ class JaxSolver(FlowSolver):
             else None
         )
         if bool(p_overflow) or not bool(converged):
-            self._prev = None  # never reuse the state that failed
+            self.reset()  # never reuse the state that failed
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
@@ -500,13 +613,21 @@ class JaxSolver(FlowSolver):
                 reason=soltel.detect_stall(tel) if tel is not None else None,
                 telemetry=tel,
             )
-        flow_np = np.asarray(flow)
+        flow_np = np.asarray(flow)  # fetched ONCE, for the decode
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
+            # flow and potentials stay device-resident between rounds:
+            # the next warm attempt consumes the handles directly
+            # instead of re-uploading what the device just produced,
+            # masked against THIS solve's endpoint buffers
+            self._prev_dev = flow if resident else None
+            self._prev_src_dev = problem.d_src if resident else None
+            self._prev_dst_dev = problem.d_dst if resident else None
+            self._prev_p = p
         objective = int(
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         ) + lower_bound_cost(problem)
-        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))  # kschedlint: host-only (FlowResult contract is int64)
+        return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=spent)  # kschedlint: host-only (FlowResult contract is int64)
 
     def solve(self, problem: FlowProblem) -> FlowResult:
         return self.complete(self.solve_async(problem))

@@ -153,12 +153,19 @@ class MegaSolver(FlowSolver):
         self._plan: Optional[MegaPlan] = None
         self._plan_dev: Optional[tuple] = None
         self._fits_ok_for: Optional[FlowProblem] = None
+        self._prev_dev = None  # warm flow as a device array (no re-upload)
+        # endpoints at the LAST SUCCESSFUL SOLVE (see jax_solver)
+        self._prev_src_dev = None
+        self._prev_dst_dev = None
         self.last_supersteps = 0
         self.last_telemetry = None
         self.last_refusal = ""
 
     def reset(self) -> None:
         self._prev = None
+        self._prev_dev = None
+        self._prev_src_dev = None
+        self._prev_dst_dev = None
         if self.fallback is not None:
             self.fallback.reset()
 
@@ -267,30 +274,47 @@ class MegaSolver(FlowSolver):
         self._fits_ok_for = None
         src = problem.src.astype(np.int32)
         dst = problem.dst.astype(np.int32)
-        cap = problem.cap.astype(np.int32)
-        supply = problem.excess.astype(np.int32)
         max_cost = int(np.abs(problem.cost).max()) if m else 0
-        cost = problem.cost.astype(np.int32) * np.int32(n)
 
         prev_plan = self._plan
         plan_dev = self._plan_for(src, dst, n)
-
-        flow0 = np.zeros(m, dtype=np.int32)
-        if self.warm_start and self._prev is not None:
-            f_prev = self._prev
-            if len(f_prev) == m and prev_plan is not None and len(prev_plan.src) == m:
-                same = (prev_plan.src == src) & (prev_plan.dst == dst)
-                flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
 
         from ..obs import soltel
         from ..ops.mcmf_pallas import mega_telemetry_cap
 
         interpret = self._resolve_interpret()
-        dev_args = (
-            jnp.asarray(_pad_pow2(cap)),
-            jnp.asarray(_pad_pow2(cost)),
-            jnp.asarray(_pad_pow2(supply)),
+        # A device-resident handle is consumable directly only when the
+        # resident pow2 extents already satisfy the kernel's _pad_pow2
+        # floor (256) — then the padded shapes ARE the resident shapes
+        # and no per-round re-upload (or device-side re-pad) is needed.
+        resident = (
+            getattr(problem, "d_cap", None) is not None
+            and m >= 256
+            and n >= 256
         )
+        if resident:
+            from ..graph.device_export import resident_solver_inputs
+
+            dev_args, flow0_dev, _warm = resident_solver_inputs(
+                problem, self._prev_dev, self._prev_src_dev,
+                self._prev_dst_dev, self.warm_start,
+            )
+        else:
+            cap = problem.cap.astype(np.int32)
+            supply = problem.excess.astype(np.int32)
+            cost = problem.cost.astype(np.int32) * np.int32(n)
+            dev_args = (
+                jnp.asarray(_pad_pow2(cap)),
+                jnp.asarray(_pad_pow2(cost)),
+                jnp.asarray(_pad_pow2(supply)),
+            )
+            flow0 = np.zeros(m, dtype=np.int32)
+            if self.warm_start and self._prev is not None:
+                f_prev = self._prev
+                if len(f_prev) == m and prev_plan is not None and len(prev_plan.src) == m:
+                    same = (prev_plan.src == src) & (prev_plan.dst == dst)
+                    flow0 = np.where(same, np.minimum(f_prev, cap), 0).astype(np.int32)
+            flow0_dev = jnp.asarray(_pad_pow2(flow0))
         # geometry rides the pending token: a later solve_async for a
         # different graph may rebuild self._plan before this dispatch
         # is complete()d (the async-pipelining seam)
@@ -302,7 +326,7 @@ class MegaSolver(FlowSolver):
             tel_cap = mega_telemetry_cap(RL[0], RL[1], tel_cap)
         fut = mcmf_loop_pallas(
             *dev_args,
-            jnp.asarray(_pad_pow2(flow0)),
+            flow0_dev,
             jnp.asarray(np.int32(1)),
             *plan_dev,
             R=RL[0], L=RL[1],
@@ -315,6 +339,7 @@ class MegaSolver(FlowSolver):
             _pad_pow2(np.zeros(m, dtype=np.int32)),
             max(1, max_cost * n),
             interpret,
+            resident,
         )
         return (problem, fut, (dev_args, plan_dev, RL, cold, tel_cap), None)
 
@@ -336,7 +361,7 @@ class MegaSolver(FlowSolver):
                 flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
-        dev_args, plan_args, (R, L), (f0_cold, eps_cold, interpret), tel_cap = rest
+        dev_args, plan_args, (R, L), (f0_cold, eps_cold, interpret, resident), tel_cap = rest
         tel_buf = None
         if tel_cap:
             flow, steps, converged, p_overflow, tel_buf = fut
@@ -372,6 +397,7 @@ class MegaSolver(FlowSolver):
         )
         if bool(p_overflow) or not bool(converged):
             self._prev = None
+            self._prev_dev = None
         if bool(p_overflow):
             raise OverflowError("push-relabel potentials approached int32 range")
         if not bool(converged):
@@ -385,6 +411,12 @@ class MegaSolver(FlowSolver):
         flow_np = np.asarray(flow)[: len(problem.src)]
         if self.warm_start:
             self._prev = flow_np.astype(np.int32)
+            # the padded kernel flow aligns with the resident extent
+            # only when no extra _pad_pow2 padding was applied
+            keep = resident and flow.shape[0] == len(problem.src)
+            self._prev_dev = flow if keep else None
+            self._prev_src_dev = problem.d_src if keep else None
+            self._prev_dst_dev = problem.d_dst if keep else None
         objective = int(
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         ) + lower_bound_cost(problem)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from ..graph.device_export import DeviceGraphState
+from ..graph.device_export import DeviceGraphState, DeviceResidentState
 from ..graph.graph_manager import GraphManager, TaskMapping
 from ..obs.devprof import get_profiler
 from ..obs.spans import span
@@ -21,11 +21,27 @@ from .decode import flow_to_mapping
 
 
 class PlacementSolver:
-    def __init__(self, gm: GraphManager, backend: FlowSolver, incremental: bool = True) -> None:
+    """``device_resident=True`` keeps the folded problem arrays live on
+    device between rounds (graph/device_export.DeviceResidentState):
+    after the first full upload, each round ships only the packed delta
+    records — one jit'd scatter applies them — and device-aware
+    backends consume the handle without re-uploading anything. Host
+    consumers (decode, cpu_ref/native ladder rungs) are unaffected: the
+    handle still carries the host arrays."""
+
+    def __init__(
+        self,
+        gm: GraphManager,
+        backend: FlowSolver,
+        incremental: bool = True,
+        device_resident: bool = False,
+    ) -> None:
         self.gm = gm
         self.backend = backend
         self.incremental = incremental
+        self.device_resident = device_resident
         self.state = DeviceGraphState()
+        self.resident = DeviceResidentState(self.state) if device_resident else None
         self._started = False
         self.last_result = None
 
@@ -55,12 +71,26 @@ class PlacementSolver:
             # Sink excess is maintained outside the journal (reference:
             # graph_manager.go:636-640); sync it before each solve.
             self.state.set_excess(gm.sink_node.id, gm.sink_node.excess)
-            problem = self.state.problem()
-        # Byte accounting from the journal just applied — NOT from the
-        # per-round ChangeStats, which miss the previous round's
+            if self.resident is not None:
+                # pack + scatter this round's delta into the persistent
+                # device buffers (delta_pack / delta_upload child spans)
+                problem = self.resident.refresh()
+            else:
+                problem = self.state.problem()
+        # Byte accounting: in device-resident mode the EXACT nbytes
+        # that crossed the boundary (packed records, or the rebuild
+        # upload); otherwise from the journal just applied — NOT from
+        # the per-round ChangeStats, which miss the previous round's
         # post-solve mutations (journaled after the round-start stats
         # reset but shipped in this scatter).
-        get_profiler().note_export(problem, full=full, changes=changes)
+        if self.resident is not None:
+            get_profiler().note_export(
+                problem,
+                full=self.resident.last_upload_kind == "full_build",
+                exact_bytes=self.resident.last_upload_bytes,
+            )
+        else:
+            get_profiler().note_export(problem, full=full, changes=changes)
         # Task nodes captured NOW: the decode must map the snapshot's
         # tasks, not tasks added while the solve is in flight.
         task_node_ids = [node.id for node in gm.task_to_node.values()]

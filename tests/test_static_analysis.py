@@ -402,6 +402,81 @@ def test_soltel_on_no_64bit_no_scatter(backend):
 
 
 # ---------------------------------------------------------------------------
+# Device-resident delta program: the SCOPED scatter exemption
+# ---------------------------------------------------------------------------
+
+
+def test_delta_apply_scatters_and_is_32bit():
+    """The delta-apply program IS allowed scatters — it applies
+    O(churn)-sized packed records once per round, where a serialized
+    scatter is the right tool — and the exemption must not be vacuous:
+    the traced program really contains scatter ops. Everything stays
+    32-bit (the device mirror never carries int64)."""
+    report = jc.check_jaxpr("delta_apply", jc.trace_delta_apply(5, 3))
+    assert report.scatter_eqns, (
+        "the delta-apply trace contains no scatters — the scoped "
+        "exemption is vacuous (did the program change shape?)"
+    )
+    assert report.ok_64bit, report.violations_64bit
+
+
+def test_delta_apply_exemption_is_scoped():
+    """The exemption covers EXACTLY ONE program: every registered
+    solver backend still traces zero scatters (the existing per-backend
+    sweep re-asserted here so the exemption test and the zero-scatter
+    rule can never pass for contradictory reasons)."""
+    for backend in jc.REGISTERED_BACKENDS:
+        report = jc.backend_report(backend, 20, 100)
+        assert report.ok_scatter, (backend, report.scatter_eqns)
+
+
+def test_delta_apply_pow2_record_bucket_hash_stable():
+    """Two record counts sharing a pow2 bucket trace byte-identical
+    delta programs (one compiled scatter per bucket, no per-delta
+    recompiles); cross-bucket hashes differ (the check isn't vacuous).
+    The graph bucket behaves the same way."""
+    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2)) == jc.jaxpr_hash(
+        jc.trace_delta_apply(7, 5)
+    )
+    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2)) != jc.jaxpr_hash(
+        jc.trace_delta_apply(100, 2)
+    )
+    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2, n_raw=20, m_raw=100)) == jc.jaxpr_hash(
+        jc.trace_delta_apply(3, 2, n_raw=24, m_raw=110)
+    )
+    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2, n_raw=20, m_raw=100)) != jc.jaxpr_hash(
+        jc.trace_delta_apply(3, 2, n_raw=20, m_raw=300)
+    )
+
+
+def test_warm_flow_program_is_elementwise():
+    """The device warm-flow carry must stay scatter- AND gather-free
+    (pure elementwise masking against the pre-delta endpoints)."""
+    report = jc.check_jaxpr("warm_flow", jc.trace_warm_flow())
+    assert report.ok_scatter, report.scatter_eqns
+    assert report.ok_64bit, report.violations_64bit
+    assert (
+        report.hbm_loop_gathers == report.kernel_gathers
+        == report.oneshot_gathers == 0
+    )
+
+
+def test_warmp_trace_is_distinct_and_scatter_free():
+    """use_warm_p=True is a DIFFERENT traced program (it consumes the
+    warm potentials and skips tighten) — still zero scatters, no
+    64-bit, pow2-bucket stable. The DEFAULT trace staying on the
+    pinned pre-warm_p baseline is asserted by
+    test_soltel_off_trace_is_the_pretelemetry_baseline."""
+    closed = jc.trace_jax_warmp(20, 100)
+    report = jc.check_jaxpr("jax+warmp", closed)
+    assert report.ok_scatter and report.ok_64bit
+    assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.traced("jax", 20, 100))
+    assert jc.jaxpr_hash(jc.trace_jax_warmp(20, 100)) == jc.jaxpr_hash(
+        jc.trace_jax_warmp(24, 110)
+    )
+
+
+# ---------------------------------------------------------------------------
 # Level 2: negative tests — each contract detects a seeded violation
 # ---------------------------------------------------------------------------
 

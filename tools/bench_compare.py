@@ -71,6 +71,22 @@ def entry_from_record(record: dict, config: Optional[str] = None,
     for key in ("supersteps_p50", "supersteps_p99", "supersteps_max"):
         if key in detail:
             entry[key] = detail[key]
+    # the churn (round-pipeline) config: lift the arm comparison into
+    # the series so the ratchet history shows WHERE the p50 comes from
+    arms = detail.get("arms")
+    if isinstance(arms, dict):
+        dr = arms.get("device_resident") or {}
+        fr = arms.get("full_rebuild") or {}
+        if dr.get("supersteps_p50") is not None:
+            entry["supersteps_p50"] = dr["supersteps_p50"]
+        if dr.get("h2d_delta_bytes_per_round") is not None:
+            entry["h2d_delta_bytes_per_round"] = dr["h2d_delta_bytes_per_round"]
+        if fr.get("p50_ms") is not None:
+            entry["full_rebuild_p50_ms"] = fr["p50_ms"]
+        if "p50_improvement_vs_full_rebuild" in detail:
+            entry["p50_improvement_vs_full_rebuild"] = detail[
+                "p50_improvement_vs_full_rebuild"
+            ]
     if record.get("accelerator_unreachable"):
         entry["accelerator_unreachable"] = True
     if note:

@@ -208,6 +208,96 @@ def report_trace(events: list) -> None:
     print(_header())
     for name in sorted(by_name):
         print(_row(name, by_name[name]))
+    report_pipeline_occupancy(events)
+
+
+#: host-side span names whose time inside a round's in-flight window
+#: counts as overlapped work (the POSTs the pipelined loop defers into
+#: the dispatch window, and the decode when a driver interleaves it)
+OVERLAP_SPAN_NAMES = ("bindings_post", "decode", "deltas", "apply")
+
+
+def pipeline_occupancy(events: list) -> Optional[dict]:
+    """Measure the double-buffered loop's overlap from a span trace:
+    for every round with a ``solve_dispatch`` → ``solve_sync`` pair,
+    the in-flight window is the gap between dispatch end and sync
+    start (the device is crunching); host spans (OVERLAP_SPAN_NAMES)
+    falling inside that window are work the pipeline hid behind the
+    solve. Returns None when the trace carries no pipelined rounds
+    (nothing dispatched asynchronously)."""
+    complete = [ev for ev in events if ev.get("ph") == "X"]
+    rounds = [ev for ev in complete if ev["name"] in ("service_round", "round")]
+    # prefer service_round (it contains the POST flush); fall back to
+    # bare scheduler rounds for driver-level traces
+    if any(ev["name"] == "service_round" for ev in rounds):
+        rounds = [ev for ev in rounds if ev["name"] == "service_round"]
+    dispatches = [ev for ev in complete if ev["name"] == "solve_dispatch"]
+    syncs = [ev for ev in complete if ev["name"] == "solve_sync"]
+    hosts = [ev for ev in complete if ev["name"] in OVERLAP_SPAN_NAMES]
+    if not rounds or not dispatches or not syncs:
+        return None
+    total_round_us = 0.0
+    total_window_us = 0.0
+    total_overlap_us = 0.0
+    windows = 0
+    for rnd in rounds:
+        r0, r1 = rnd["ts"], rnd["ts"] + rnd.get("dur", 0.0)
+
+        def inside(ev):
+            return ev["ts"] >= r0 and ev["ts"] + ev.get("dur", 0.0) <= r1
+
+        ds = [ev for ev in dispatches if inside(ev)]
+        ss = [ev for ev in syncs if inside(ev)]
+        if not ds or not ss:
+            continue
+        w0 = min(ev["ts"] + ev.get("dur", 0.0) for ev in ds)
+        w1 = max(ev["ts"] for ev in ss)
+        if w1 <= w0:
+            continue
+        overlap = 0.0
+        for ev in hosts:
+            h0, h1 = ev["ts"], ev["ts"] + ev.get("dur", 0.0)
+            overlap += max(0.0, min(h1, w1) - max(h0, w0))
+        total_round_us += r1 - r0
+        total_window_us += w1 - w0
+        total_overlap_us += overlap
+        windows += 1
+    if not windows:
+        return None
+    return {
+        "rounds_with_window": windows,
+        "round_wall_ms": total_round_us / 1e3,
+        "inflight_window_ms": total_window_us / 1e3,
+        "overlapped_host_ms": total_overlap_us / 1e3,
+        # the headline: fraction of round wall where upload/solve
+        # overlapped decode/bind work on the host
+        "occupancy_of_round": (
+            total_overlap_us / total_round_us if total_round_us else 0.0
+        ),
+        "occupancy_of_window": (
+            total_overlap_us / total_window_us if total_window_us else 0.0
+        ),
+    }
+
+
+def report_pipeline_occupancy(events: list) -> None:
+    occ = pipeline_occupancy(events)
+    if occ is None:
+        return
+    print()
+    print(
+        f"pipeline occupancy: {occ['rounds_with_window']} round(s) with an "
+        f"in-flight solve window"
+    )
+    print(
+        f"  round wall {occ['round_wall_ms']:.2f} ms, in-flight window "
+        f"{occ['inflight_window_ms']:.2f} ms, overlapped host work "
+        f"{occ['overlapped_host_ms']:.2f} ms"
+    )
+    print(
+        f"  {occ['occupancy_of_round']:.1%} of round wall overlapped the "
+        f"solve ({occ['occupancy_of_window']:.1%} of the in-flight window)"
+    )
 
 
 def load_and_report(path: str, phase_metric: str) -> None:
@@ -237,6 +327,11 @@ def load_and_report(path: str, phase_metric: str) -> None:
             if doc.get("solver_stalls"):
                 print()
                 report_stalls(doc["solver_stalls"])
+            # the ring's span slices double as a trace: surface the
+            # double-buffered loop's overlap from any flight dump
+            report_pipeline_occupancy(
+                [ev for entry in doc["rounds"] for ev in entry.get("spans", [])]
+            )
             return
         if "traceEvents" in doc:
             report_trace(doc["traceEvents"])

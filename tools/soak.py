@@ -208,6 +208,9 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
         )
         span_tracer = SpanTracer().install()
 
+    pipeline = getattr(args, "loop", "sync") == "pipelined"
+    device_resident = bool(getattr(args, "device_resident", False))
+
     def make_service():
         return SchedulerService(
             api,
@@ -219,6 +222,8 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
             round_deadline_s=30.0,
             flight=flight,
             span_tracer=span_tracer,
+            pipeline=pipeline,
+            device_resident=device_resident,
         )
 
     svc = make_service()
@@ -268,6 +273,15 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
             if not injector.machine_silent(mid):
                 svc.monitor.record_machine_heartbeat(mid, now=now)
 
+        # Pipelined loops post round r's bindings in round r+1's
+        # dispatch window — AFTER that round's poll, which would shift
+        # a dropped binding's pod resurface by one poll vs the sync
+        # loop. The soak drives LOGICAL rounds and asserts cross-loop
+        # placement parity, so it flushes before polling: the POST
+        # sequence (and every drop draw) hits the API in the same
+        # order and poll alignment as the synchronous loop. The live
+        # service (cli.run) keeps the overlap window instead.
+        svc.flush_pending_bindings()
         pods = api.poll_pod_batch(0.005)
         svc.run_round(pods, now=now)
 
@@ -309,6 +323,8 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
                     round_deadline_s=30.0,
                     flight=flight,
                     span_tracer=span_tracer,
+                    pipeline=pipeline,
+                    device_resident=device_resident,
                 )
             svc.enable_heartbeats(machine_timeout_s=hb_timeout_s, task_timeout_s=1e9)
             assert dict(svc.scheduler.task_bindings) == before_bindings, (
@@ -332,6 +348,9 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
     noops = sum(1 for rec in tracer.records if rec.noop_round)
     degr = sum(rec.degradations for rec in tracer.records)
     dt = time.perf_counter() - t0
+    # a pipelined loop holds the final round's POSTs for a dispatch
+    # window that will never come; flush before reading api.bindings()
+    svc.flush_pending_bindings()
     placements = {
         pod: api.bindings().get(pod)
         for pod in sorted(svc.pod_to_task)
@@ -406,6 +425,53 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
 
 
 def chaos_main(args) -> int:
+    import copy
+
+    if getattr(args, "verify_loop_parity", False):
+        # The pipeline-parity acceptance check: the SAME seeded chaos
+        # soak through the synchronous, pipelined, and pipelined+
+        # device-resident service loops must produce bit-identical
+        # placements (and identical API-side bindings once the deferred
+        # POSTs flush). Fault TOTALS are compared per-domain except
+        # binding drops: deferring POSTs by one dispatch window can
+        # shift which re-post batch a drop draw lands on — placements
+        # are unaffected (drops never touch the scheduler's graph).
+        runs = {}
+        for label, loop, resident in (
+            ("sync", "sync", False),
+            ("pipelined", "pipelined", False),
+            ("device-resident", "pipelined", True),
+        ):
+            a = copy.copy(args)
+            a.loop = loop
+            a.device_resident = resident
+            print(f"--- loop parity arm: {label} ---", flush=True)
+            runs[label] = run_chaos_soak(a)
+        base = runs["sync"]
+        for label in ("pipelined", "device-resident"):
+            got = runs[label]
+            for key in ("placements", "all_bindings"):
+                assert got[key] == base[key], (
+                    f"loop mode {label!r} diverged from sync: {key} differs"
+                )
+            for k, v in base["fault_totals"].items():
+                if k == "binding_drop":
+                    continue
+                assert got["fault_totals"].get(k, 0) == v, (
+                    f"loop mode {label!r}: fault {k} {got['fault_totals'].get(k, 0)} != {v}"
+                )
+            assert got["noop_rounds"] == base["noop_rounds"], (
+                f"loop mode {label!r}: noop_rounds differ "
+                f"({got['noop_rounds']} != {base['noop_rounds']})"
+            )
+        print(
+            "LOOP PARITY OK: bit-identical placements and bindings across "
+            "sync / pipelined / device-resident loops "
+            f"({len(base['placements'])} placements, "
+            f"noop_rounds={base['noop_rounds']}, "
+            f"degradations={base['degradations']})"
+        )
+        return 0
     got = run_chaos_soak(args)
     if args.verify_determinism:
         again = run_chaos_soak(args)
@@ -445,6 +511,20 @@ def main() -> int:
     ap.add_argument("--verify-determinism", action="store_true",
                     help="chaos mode: run twice, require identical "
                     "placements + fault totals")
+    ap.add_argument("--loop", choices=["sync", "pipelined"], default="sync",
+                    help="chaos mode: service round structure — "
+                    "'pipelined' double-buffers rounds (solve dispatch "
+                    "overlaps the previous round's binding POSTs; "
+                    "docs/round_pipeline.md)")
+    ap.add_argument("--device-resident", action="store_true",
+                    help="chaos mode: keep the flow problem device-"
+                    "resident between rounds (delta-record scatter "
+                    "instead of full re-uploads)")
+    ap.add_argument("--verify-loop-parity", action="store_true",
+                    help="chaos mode: run the soak through the sync, "
+                    "pipelined, and pipelined+device-resident loops and "
+                    "require bit-identical placements across all three "
+                    "(the round-pipeline acceptance check)")
     ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                     help="chaos mode: serve live Prometheus text on "
                     "/metricsz during the soak (0 = ephemeral port) and "
