@@ -18,7 +18,9 @@
 #                     loops, including mid-flight rung degradation
 #                     (docs/round_pipeline.md)
 #   make bench-gate   check BENCH_TRAJECTORY.jsonl: fail if any config's
-#                     newest p50 regressed >15% vs its previous entry
+#                     newest p50 regressed >15% vs its previous entry,
+#                     or its supersteps_p50 regressed >25% (+8 slack)
+#                     for series that carry it — the churn/event path
 #                     (tools/bench_compare.py; append runs with
 #                     `python tools/bench_compare.py append ... --from-bench`)
 #   make verify       lint, then tests, then the chaos + obs smokes

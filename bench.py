@@ -605,10 +605,11 @@ def _churn_pipeline_bench(
     pipeline (event path: FlowScheduler + PlacementSolver + JaxSolver).
 
     Three arms run the IDENTICAL seeded scenario — same graph
-    evolution, same solver policy (budgeted warm attempt with restart
-    escape), so placements are bit-identical BY CONSTRUCTION and the
-    bench asserts it every round. The arms differ only in how the
-    folded problem reaches the solver:
+    evolution, same solver policy (slot-stable plan + dirty-frontier
+    price refit, budgeted restart escape as backstop), so placements
+    are bit-identical BY CONSTRUCTION and the bench asserts it every
+    round. The arms differ only in how the folded problem reaches the
+    solver:
 
     - ``full_rebuild``: the r9 status-quo export — every round
       re-copies/refolds ALL host arrays (problem() cache bypassed) and
@@ -617,16 +618,20 @@ def _churn_pipeline_bench(
       scatters into the host arrays and the problem() cache rebuilds
       only dirty groups; the device still receives full uploads;
     - ``device_resident``: persistent device buffers — only packed
-      delta records cross the host/device boundary (one jit'd
-      scatter), and warm flow + potentials stay device-resident.
+      delta records cross the host/device boundary (the problem-delta
+      scatter AND the plan-row scatter), warm flow + potentials stay
+      device-resident.
 
-    A fourth ``reference`` measurement runs the full_rebuild export
-    with the r9 solver defaults (no restart escape) — the path that
-    shipped before this change — to attribute the solver-policy win
-    separately from the export win. ``cold_control`` additionally
-    measures the canonical cold solve (zero flow, full cost-scaling
-    from eps = max|cost|·n — the complete() fallback) on the final
-    round's problem, the baseline for the warm-supersteps claim.
+    Two baseline measurements attribute the win: ``reference`` runs
+    the full_rebuild export with the r9 solver defaults (legacy plan,
+    no warm potentials, no restart escape) and ``r11_policy`` runs the
+    device-resident export with the r11 policy (legacy argsort plan
+    rebuilt per endpoint change, warm prices OFF, budgeted restart
+    escape as the price-war band-aid) — the 407 ms/747-supersteps p50
+    path this change retires. ``cold_control`` additionally measures
+    the canonical cold solve (zero flow, full cost-scaling from
+    eps = max|cost|·n — the complete() fallback) on the final round's
+    problem, the baseline for the warm-supersteps claim.
 
     The arms are INTERLEAVED round-robin, one round each per logical
     round: ambient machine drift (the dominant noise on CPU, measured
@@ -647,17 +652,24 @@ def _churn_pipeline_bench(
     from ksched_tpu.utils import seed_rng
 
     k = max(1, int(tasks * churn))
+    # the arms sharing the new default policy — placements must match
+    # bit-for-bit across these, every round
+    _PARITY_ARMS = ("full_rebuild", "delta_scatter", "device_resident")
+    # (label, export, restart_budget, r11-policy?) — r11 policy =
+    # legacy argsort plan + warm prices OFF (the defaults before the
+    # slot-stable plan and the dirty-frontier refit landed)
     arm_specs = (
-        ("reference", "full", None),
-        ("full_rebuild", "full", restart_budget),
-        ("delta_scatter", "cache", restart_budget),
-        ("device_resident", "resident", restart_budget),
+        ("reference", "full", None, True),
+        ("r11_policy", "resident", restart_budget, True),
+        ("full_rebuild", "full", restart_budget, False),
+        ("delta_scatter", "cache", restart_budget, False),
+        ("device_resident", "resident", restart_budget, False),
     )
     out_arms = {}
     placements_by_round = {}
 
     class _Arm:
-        def __init__(self, label, export, budget):
+        def __init__(self, label, export, budget, r11_policy):
             self.label = label
             self.export = export
             # the reference (status-quo) arm's warm attempts degenerate
@@ -670,7 +682,12 @@ def _churn_pipeline_bench(
             self.prof = DeviceProfiler(registry=self.reg)
             set_profiler(self.prof)
             seed_rng(7)
-            self.solver = JaxSolver(restart_budget=budget)
+            self.solver = JaxSolver(
+                restart_budget=budget,
+                slot_stable=not r11_policy,
+                warm_potentials=not r11_policy,
+                journal_scoped_warm=not r11_policy,
+            )
             (
                 self.sched, self.rmap, self.jmap, self.tmap, self.root,
             ) = build_cluster(
@@ -691,6 +708,9 @@ def _churn_pipeline_bench(
             self.lat_ms = []
             self.ss_hist = []
             self.h2d_mark = (0.0, 0.0)
+            self.plan_kinds = {}  # resident plan sync kinds, post-warmup
+            self.plan_bytes = 0
+            self.scope_counts = {}  # journal-scoped warm decisions
             self.stalled_at = None
             # task/job ids come from the process-global seeded RNG
             # (utils.seed_rng); interleaved arms must each see their
@@ -742,7 +762,7 @@ def _churn_pipeline_bench(
                 )
                 return
             wall_ms = (time.perf_counter() - t0) * 1e3
-            if self.label != "reference":
+            if self.label in _PARITY_ARMS:
                 snap = {
                     tmap.find(t).name: rid
                     for t, rid in sched.task_bindings.items()
@@ -752,6 +772,13 @@ def _churn_pipeline_bench(
                 return
             self.lat_ms.append(wall_ms)
             self.ss_hist.append(self.solver.last_supersteps)
+            scope = self.solver.last_warm_scope
+            self.scope_counts[scope] = self.scope_counts.get(scope, 0) + 1
+            if self.export == "resident":
+                res = sched.solver.resident
+                kind = res.last_plan_kind
+                self.plan_kinds[kind] = self.plan_kinds.get(kind, 0) + 1
+                self.plan_bytes += res.last_plan_bytes
             if verbose:
                 print(
                     f"# churn[{self.label}] round {r}: {wall_ms:.1f}ms "
@@ -784,8 +811,10 @@ def _churn_pipeline_bench(
             "fill_s": round(a.fill_s, 2),
             "fill_supersteps": int(a.fill_ss),
             "supersteps_p50": int(np.percentile(ss_hist, 50)) if ss_hist else None,
+            "supersteps_p99": int(np.percentile(ss_hist, 99)) if ss_hist else None,
             "supersteps_max": int(max(ss_hist)) if ss_hist else None,
             "measured_rounds": len(lat_ms),
+            "warm_scope_rounds": dict(a.scope_counts),
             "h2d_full_bytes": int(full_b - h2d_mark[0]),
             "h2d_delta_bytes": int(delta_b - h2d_mark[1]),
             "h2d_delta_bytes_per_round": int((delta_b - h2d_mark[1]) / measured),
@@ -800,6 +829,7 @@ def _churn_pipeline_bench(
             )
         if export == "resident":
             sched.solver.resident.parity_check()
+            sched.solver.resident.plan_parity_check()
             arm["h2d_accounting"] = "exact (packed-record nbytes)"
             # for the resident arm the counted delta bytes ARE
             # the real per-round upload
@@ -807,6 +837,23 @@ def _churn_pipeline_bench(
             arm["delta_records_last"] = int(
                 sched.solver.resident.last_arc_records
                 + sched.solver.resident.last_node_records
+            )
+            # slot-stable plan maintenance: sync kinds per measured
+            # round (clean = no endpoint churn, delta = packed plan
+            # records through the scatter, rebuild = layout rebuilt —
+            # full_build / bucket growth / region overflow only) and
+            # the plan bytes that rode the boundary post-warmup
+            arm["plan_sync_kinds"] = dict(a.plan_kinds)
+            arm["plan_bytes_total"] = int(a.plan_bytes)
+            arm["plan_bytes_per_round"] = int(a.plan_bytes / measured)
+            arm["plan_layout_rebuilds"] = int(
+                sched.solver.state.plan.layout_rebuilds
+            )
+            arm["plan_region_overflows"] = int(
+                sched.solver.state.plan.region_overflows
+            )
+            arm["plan_region_relocations"] = int(
+                sched.solver.state.plan.region_relocations
             )
         else:
             arm["h2d_accounting"] = (
@@ -869,10 +916,9 @@ def _churn_pipeline_bench(
     # arm that stalled mid-run (recorded above as data) simply stops
     # contributing rounds; parity is asserted over whatever overlap
     # exists — at least two arms per compared round.
-    parity_arms = ("full_rebuild", "delta_scatter", "device_resident")
     compared = 0
     for r, per_arm in sorted(placements_by_round.items()):
-        present = [a for a in parity_arms if a in per_arm]
+        present = [a for a in _PARITY_ARMS if a in per_arm]
         if len(present) < 2:
             continue
         base = per_arm[present[0]]
@@ -891,6 +937,7 @@ def _churn_pipeline_bench(
     dr = out_arms["device_resident"]
     fr = out_arms["full_rebuild"]
     ref = out_arms["reference"]
+    r11 = out_arms["r11_policy"]
     target_ms = 10.0
     dr_p50 = dr.get("p50_ms")
     return {
@@ -911,6 +958,7 @@ def _churn_pipeline_bench(
             "parity_rounds_compared": compared,
             "p50_improvement_vs_full_rebuild": _improvement(dr, fr),
             "p50_improvement_vs_reference_path": _improvement(dr, ref),
+            "p50_improvement_vs_r11_policy": _improvement(dr, r11),
             "restart_budget": restart_budget,
             "rounds": rounds,
             "warmup_rounds": warmup,

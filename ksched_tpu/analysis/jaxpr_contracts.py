@@ -14,12 +14,17 @@ assert:
 - **no-scatter**: zero scatter-family primitives in any backend's
   solve. TPU serializes scatter-adds (~68 ms for a 64k segment_sum,
   jax_solver.py header); every segment reduction must stay in
-  cumsum/gather/associative-scan form. ONE program holds a scoped
-  exemption: the device-resident delta apply
-  (graph/device_export.delta_apply_fn), which scatters O(churn)-sized
-  packed records once per round — `trace_delta_apply` pins that it
-  scatters (the exemption is real), stays 32-bit, and hashes stably
-  within a pow2 record bucket; every solver program stays at zero.
+  cumsum/gather/associative-scan form. Exactly TWO programs hold
+  scoped exemptions, both O(churn)-sized once-per-round maintenance
+  scatters that run OUTSIDE every solve: the device-resident problem
+  delta apply (graph/device_export.delta_apply_fn, pinned by
+  `trace_delta_apply`) and the slot-stable plan-row apply
+  (graph/slot_plan.plan_apply_fn, pinned by `trace_plan_apply`). Each
+  pin asserts the exemption is real (the program actually scatters),
+  stays 32-bit, and hashes stably within a pow2 record bucket; every
+  solver program stays at zero — including the slot-stable solve
+  variant (`trace_jax_slot_stable`) and the dirty-frontier warm-price
+  refit (`trace_jax_warmp`).
 - **mega gather budget** (locking in the megakernel's zero-HBM-gather
   claim, ops/mcmf_pallas.py): inside the mega `pallas_call` body every
   operand is VMEM/SMEM-resident by BlockSpec construction, the only
@@ -421,18 +426,24 @@ def trace_sharded(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0)
     )
 
 
-def trace_jax_warmp(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0):
-    """The warm-potentials variant of the CSR solve: use_warm_p=True
-    takes the previous round's device-resident prices and skips the
-    tightening pass. A distinct traced program — the default
-    (warm_p=None, use_warm_p=False) trace stays byte-identical to the
-    pinned pre-warm_p baseline, which test_static_analysis pins."""
+def trace_jax_warmp(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0,
+                    slot_stable: bool = False):
+    """The warm-potentials variant of the CSR solve — since the
+    dirty-frontier refit landed, use_warm_p=True SEEDS the tightening
+    Bellman sweep with the previous round's device-resident prices
+    (clipped), so the relaxation touches only the journal-dirty
+    frontier. The refit is plain data-parallel relaxation: it must
+    stay scatter-free like every solve program. A distinct traced
+    program — the default (warm_p=None, use_warm_p=False) trace stays
+    byte-identical to the pinned pre-warm_p baseline, which
+    test_static_analysis pins."""
     from ..solver.jax_solver import _solve_mcmf
 
     n, m = bucketed_sizes(n_raw, m_raw)
     fn = functools.partial(
         _solve_mcmf, alpha=8, max_supersteps=4096, tighten_sweeps=32,
         telemetry_cap=telemetry_cap, use_warm_p=True,
+        slot_stable=slot_stable,
     )
     e = 2 * m
     return jax.make_jaxpr(fn)(
@@ -444,8 +455,76 @@ def trace_jax_warmp(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 
     )
 
 
+def slot_stable_entry_cap(m_pad: int) -> int:
+    """The entry-table extent the slot-stable layout pads to for an
+    m_pad-arc bucket in the common case (graph/slot_plan.SlotPlanState
+    ._rebuild: max(2*m_cap, next_pow2(need)) — need exceeds 2*m_cap
+    only when per-node slack rows outgrow the doubled entries, which
+    next_pow2 then absorbs; either way a pow2 of the bucket, never the
+    raw size)."""
+    return 2 * m_pad
+
+
+def trace_jax_slot_stable(n_raw: int, m_raw: int, seed: int = 0,
+                          telemetry_cap: int = 0):
+    """The slot-stable variant of the CSR solve: entry rows live in
+    fixed per-node regions with slack and liveness rides the sign
+    column (graph/slot_plan.py), so the residual formula masks dead
+    rows to zero. Still a solve program: zero scatters, no 64-bit,
+    pow2-bucket hash stable (the entry extent is a function of the
+    m-bucket alone)."""
+    from ..solver.jax_solver import _solve_mcmf
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    fn = functools.partial(
+        _solve_mcmf, alpha=8, max_supersteps=4096, tighten_sweeps=32,
+        telemetry_cap=telemetry_cap, slot_stable=True,
+    )
+    e = slot_stable_entry_cap(m)
+    return jax.make_jaxpr(fn)(
+        _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()),
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)),
+        _sds((e,), jnp.bool_), _sds((2 * m,)),
+        _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+    )
+
+
+def trace_plan_apply(
+    kp_raw: int, ki_raw: int, n_raw: int = 20, m_raw: int = 100,
+    ks_raw: int = 0, kn_raw: int = 0,
+):
+    """Abstract trace of the SECOND (and last) scatter-exempt program:
+    the slot-stable plan-row + boundary-static apply over pow2-bucketed
+    record counts (graph/slot_plan.plan_apply_fn). The seg/node static
+    streams carry real dirt only on region-relocation rounds; on
+    ordinary churn rounds they are minimum-bucket idempotent pads, so
+    the common-case program is the (kp, ki, 1, 1)-bucket one."""
+    from ..graph.device_export import pad_record_count
+    from ..graph.slot_plan import (
+        INV_RECORD_COLS,
+        NODE_RECORD_COLS,
+        PLAN_RECORD_COLS,
+        SEG_RECORD_COLS,
+        plan_apply_fn,
+    )
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    e = slot_stable_entry_cap(m)
+    kp = pad_record_count(kp_raw)
+    ki = pad_record_count(ki_raw)
+    ks = pad_record_count(ks_raw)
+    kn = pad_record_count(kn_raw)
+    return jax.make_jaxpr(plan_apply_fn())(
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((2 * m,)),
+        _sds((e,)), _sds((e,), jnp.bool_),
+        _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+        _sds((kp, PLAN_RECORD_COLS)), _sds((ki, INV_RECORD_COLS)),
+        _sds((ks, SEG_RECORD_COLS)), _sds((kn, NODE_RECORD_COLS)),
+    )
+
+
 def trace_delta_apply(ka_raw: int, kn_raw: int, n_raw: int = 20, m_raw: int = 100):
-    """Abstract trace of the ONE scatter-exempt program: the
+    """Abstract trace of the FIRST scatter-exempt program: the
     device-resident delta apply over pow2-bucketed record counts
     (graph/device_export.delta_apply_fn)."""
     from ..graph.device_export import (

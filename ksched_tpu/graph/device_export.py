@@ -31,6 +31,7 @@ Two consumers read the per-round mutations:
 from __future__ import annotations
 
 import functools
+import itertools
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -60,10 +61,24 @@ class FlowProblem:
     cost: np.ndarray  # int32[M]
     flow_offset: np.ndarray  # int32[M] folded lower bounds
     num_arcs: int  # live arc slots (<= len(src))
+    #: slot-stable CSR plan handle (graph/slot_plan.SlotPlanState) when
+    #: the problem came from a DeviceGraphState; None for plain
+    #: array-built problems (bulk, tests) — consumers that don't know
+    #: about it (cpu_ref, native, ell, mega, sharded) just ignore it
+    plan: object = None
+    #: cheap endpoint-structure generation key
+    #: (state uid, rebuild_count, n_cap, m_cap, endpoint_gen): two
+    #: problems with equal keys have identical arc endpoints, so
+    #: solver plan caches can skip their O(M) endpoint scans entirely
+    #: on clean rounds (None = unknown, fall back to comparing arrays)
+    plan_key: object = None
 
     @property
     def total_supply(self) -> int:
         return int(self.excess[self.excess > 0].sum())
+
+
+_STATE_UIDS = itertools.count()
 
 
 class DeviceGraphState:
@@ -97,6 +112,18 @@ class DeviceGraphState:
         #: any device mirror of the arc arrays is wholesale invalid
         #: (growth keeps slots stable and is signaled by n_cap/m_cap)
         self.rebuild_count = 0
+        #: bumped whenever some slot's (src, dst) actually changes —
+        #: cap/cost-only journals leave it alone, so solver plan caches
+        #: keyed on plan_key() skip their endpoint scans on clean rounds
+        self.endpoint_gen = 0
+        self._uid = next(_STATE_UIDS)
+        #: slot-stable CSR plan (graph/slot_plan.py): an inert shell
+        #: until a slot-stable consumer calls plan.ensure_built();
+        #: after that the _set_arc hooks below keep it in sync per
+        #: endpoint change, O(1) each
+        from .slot_plan import SlotPlanState
+
+        self.plan = SlotPlanState(self)
         # -- mutation tracking ------------------------------------------
         # Two consumers, two mechanisms: the problem() cache needs only
         # "did anything in this group change" booleans; the device-
@@ -152,6 +179,12 @@ class DeviceGraphState:
         self.cost = np.zeros(self.m_cap, dtype=np.int32)
         self.fold = np.zeros(self.n_cap, dtype=np.int64)  # kschedlint: host-only (host graph arrays; the device mirror is int32)
         self.generation += 1
+        self.plan.invalidate()
+
+    def plan_key(self) -> Tuple:
+        """Endpoint-structure generation key for this state's current
+        arrays (see FlowProblem.plan_key)."""
+        return (self._uid, self.rebuild_count, self.n_cap, self.m_cap, self.endpoint_gen)
 
     def full_build(self, graph: FlowGraph) -> None:
         n = graph.max_node_id
@@ -182,6 +215,7 @@ class DeviceGraphState:
         self.fold = np.concatenate([self.fold, np.zeros(new_cap - self.n_cap, np.int64)])  # kschedlint: host-only (host graph arrays; the device mirror is int32)
         self.n_cap = new_cap
         self.generation += 1
+        self.plan.invalidate()  # regions must cover the new rows
         # shapes changed: every cached materialization is stale
         self._cache = None
         self._cache_nodes_ok = False
@@ -197,6 +231,7 @@ class DeviceGraphState:
             setattr(self, name, np.concatenate([arr, np.zeros(pad, arr.dtype)]))
         self.m_cap = new_cap
         self.generation += 1
+        self.plan.invalidate()  # entry budget + inv_order extent stale
         self._cache = None
         self._cache_nodes_ok = False
         self._cache_arcs_ok = False
@@ -215,6 +250,8 @@ class DeviceGraphState:
         low0 = int(self.low[slot]) if slot is not None else 0
         if cap == 0 and low == 0:
             if slot is not None:
+                self.plan.slot_freed(slot, src, dst)
+                self.endpoint_gen += 1
                 self.cap[slot] = 0
                 self.low[slot] = 0
                 self.cost[slot] = 0
@@ -232,6 +269,8 @@ class DeviceGraphState:
         if slot is None:
             slot = self._take_slot()
             self._arc_slot[key] = slot
+            self.plan.slot_assigned(slot, src, dst)
+            self.endpoint_gen += 1
         if low != low0:
             # fold delta: an arc (src, dst) with lower bound L
             # contributes -L to src's folded excess and +L to dst's
@@ -315,6 +354,8 @@ class DeviceGraphState:
             cost=cost,
             flow_offset=flow_offset,
             num_arcs=self._num_slots,
+            plan=self.plan,
+            plan_key=self.plan_key(),
         )
         self._cache_nodes_ok = True
         self._cache_arcs_ok = True
@@ -452,6 +493,10 @@ class DeviceResidentProblem(FlowProblem):
     d_dst: object = None  # jax int32[m_cap]
     d_cap: object = None  # jax int32[m_cap] folded residual bound
     d_cost: object = None  # jax int32[m_cap] UNSCALED costs
+    #: scatter-maintained slot-stable plan tensors in _solve_mcmf
+    #: order (graph/slot_plan.py), or None until the mirror's first
+    #: plan sync (the solver then full-uploads via the plan handle)
+    d_plan: object = None
     resident: object = None  # owning DeviceResidentState
     version: int = 0
 
@@ -533,6 +578,25 @@ class DeviceResidentState:
         self.last_arc_records = 0
         self.last_node_records = 0
         self._scaled = None  # (version, jax scaled-cost buffer)
+        # ---- slot-stable plan mirror (graph/slot_plan.py) ------------
+        self.d_p_arc = None
+        self.d_p_sign = None
+        self.d_p_src = None
+        self.d_p_dst = None
+        self.d_inv = None
+        #: boundary statics — mirror-OWNED copies (they are donated to
+        #: the plan scatter when a relocation rewires them, so they
+        #: must never alias the plan's own full-upload cache)
+        self.d_seg = None
+        self.d_isstart = None
+        self.d_first = None
+        self.d_last = None
+        self.d_nonempty = None
+        self._plan_gen = -1  # layout_gen mirrored
+        self._plan_ver = -1  # value_version mirrored
+        self.last_plan_kind = "none"  # none | rebuild | delta | clean
+        self.last_plan_bytes = 0
+        self.last_plan_records = 0
 
     # -- packing -----------------------------------------------------------
 
@@ -640,6 +704,7 @@ class DeviceResidentState:
         self._n_cap = st.n_cap
         self._m_cap = st.m_cap
         self.version += 1
+        d_plan = self._sync_plan()
         return DeviceResidentProblem(
             num_nodes=problem.num_nodes,
             excess=problem.excess,
@@ -655,8 +720,90 @@ class DeviceResidentState:
             d_dst=self.d_dst,
             d_cap=self.d_cap,
             d_cost=self.d_cost,
+            d_plan=d_plan,
             resident=self,
             version=self.version,
+            plan=st.plan,
+            plan_key=st.plan_key(),
+        )
+
+    def _sync_plan(self):
+        """Mirror the slot-stable plan (graph/slot_plan.py) as
+        persistent device tensors. Inactive until a slot-stable
+        consumer enables the plan (so non-jax backends pay nothing);
+        afterwards each round ships only the dirty plan rows / inv
+        entries through the ONE jit'd plan scatter, and the full
+        re-upload survives only on layout rebuilds (full_build, pow2
+        bucket growth, region overflow). Returns the plan tensors in
+        `_solve_mcmf` order, or None while inactive."""
+        from ..obs.spans import span
+
+        plan = self.state.plan
+        self.last_plan_kind = "none"
+        self.last_plan_bytes = 0
+        self.last_plan_records = 0
+        if plan is None or not plan.enabled:
+            return None
+        import jax.numpy as jnp
+
+        plan.ensure_built()
+        if self._plan_gen != plan.layout_gen:
+            # layout rebuilt: fresh buffers all around (they will be
+            # donated by later scatters, so never share the plan's own
+            # full-upload cache)
+            with span("plan_upload", kind="rebuild"):
+                self.d_p_arc = jnp.asarray(plan.p_arc)
+                self.d_p_sign = jnp.asarray(plan.p_sign)
+                self.d_p_src = jnp.asarray(plan.p_src)
+                self.d_p_dst = jnp.asarray(plan.p_dst)
+                self.d_inv = jnp.asarray(plan.inv_order)
+                self.d_seg = jnp.asarray(plan.seg_start)
+                self.d_isstart = jnp.asarray(plan.is_start)
+                self.d_first = jnp.asarray(plan.node_first)
+                self.d_last = jnp.asarray(plan.node_last)
+                self.d_nonempty = jnp.asarray(plan.node_nonempty)
+            plan.clear_pending()
+            self._plan_gen = plan.layout_gen
+            self._plan_ver = plan.value_version
+            self.last_plan_kind = "rebuild"
+            self.last_plan_bytes = plan.values_nbytes() + plan.static_nbytes()
+            self.last_upload_bytes += self.last_plan_bytes
+        elif plan.value_version != self._plan_ver or plan.has_pending:
+            from .slot_plan import plan_apply_fn
+
+            row_rec, inv_rec, seg_rec, node_rec = plan.drain_records()
+            rec_bytes = (
+                row_rec.nbytes + inv_rec.nbytes
+                + seg_rec.nbytes + node_rec.nbytes
+            )
+            with span("plan_upload", kind="delta", bytes=rec_bytes):
+                apply_plan = plan_apply_fn()
+                (
+                    self.d_p_arc, self.d_p_sign, self.d_p_src,
+                    self.d_p_dst, self.d_inv,
+                    self.d_seg, self.d_isstart,
+                    self.d_first, self.d_last, self.d_nonempty,
+                ) = apply_plan(
+                    self.d_p_arc, self.d_p_sign, self.d_p_src,
+                    self.d_p_dst, self.d_inv,
+                    self.d_seg, self.d_isstart,
+                    self.d_first, self.d_last, self.d_nonempty,
+                    jnp.asarray(row_rec), jnp.asarray(inv_rec),
+                    jnp.asarray(seg_rec), jnp.asarray(node_rec),
+                )
+            self._plan_ver = plan.value_version
+            self.last_plan_kind = "delta"
+            self.last_plan_bytes = rec_bytes
+            self.last_plan_records = (
+                len(row_rec) + len(inv_rec) + len(seg_rec) + len(node_rec)
+            )
+            self.last_upload_bytes += self.last_plan_bytes
+        else:
+            self.last_plan_kind = "clean"
+        return (
+            self.d_p_arc, self.d_p_sign, self.d_p_src, self.d_p_dst,
+            self.d_seg, self.d_isstart, self.d_inv,
+            self.d_first, self.d_last, self.d_nonempty,
         )
 
     def _scatter_arcs(self, arc_rec: np.ndarray) -> None:
@@ -702,6 +849,39 @@ class DeviceResidentState:
                 bad = np.nonzero(got != host)[0][:8]
                 raise AssertionError(
                     f"device mirror diverged from host {name} at rows "
+                    f"{bad.tolist()}: device={got[bad].tolist()} "
+                    f"host={host[bad].tolist()}"
+                )
+
+    def plan_parity_check(self) -> None:
+        """Assert the scatter-maintained device plan tensors equal the
+        host-maintained plan arrays bit-for-bit (the full-rebuild
+        materialization; test/debug only)."""
+        plan = self.state.plan
+        if plan is None or not plan.enabled or self._plan_gen < 0:
+            return
+        if plan.needs_rebuild or self._plan_gen != plan.layout_gen or (
+            self._plan_ver != plan.value_version
+        ):
+            return  # mirror legitimately behind (mutations since refresh)
+        pairs = (
+            ("p_arc", self.d_p_arc, plan.p_arc),
+            ("p_sign", self.d_p_sign, plan.p_sign),
+            ("p_src", self.d_p_src, plan.p_src),
+            ("p_dst", self.d_p_dst, plan.p_dst),
+            ("inv_order", self.d_inv, plan.inv_order),
+            ("seg_start", self.d_seg, plan.seg_start),
+            ("is_start", self.d_isstart, plan.is_start),
+            ("node_first", self.d_first, plan.node_first),
+            ("node_last", self.d_last, plan.node_last),
+            ("node_nonempty", self.d_nonempty, plan.node_nonempty),
+        )
+        for name, dev, host in pairs:
+            got = np.asarray(dev)
+            if not np.array_equal(got, host):
+                bad = np.nonzero(got != host)[0][:8]
+                raise AssertionError(
+                    f"device plan mirror diverged from host {name} at rows "
                     f"{bad.tolist()}: device={got[bad].tolist()} "
                     f"host={host[bad].tolist()}"
                 )

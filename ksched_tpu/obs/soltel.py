@@ -409,6 +409,39 @@ def note_stall(reason: dict, tel: Optional[SolveTelemetry] = None) -> dict:
     return event
 
 
+def warm_price_war(
+    backend: str,
+    supersteps: int,
+    budget: int,
+    escaped_to: str = "fresh_restart",
+    tel: Optional[SolveTelemetry] = None,
+) -> dict:
+    """Structured price-war event: a WARM attempt burned its superstep
+    budget without converging and the solver is escaping to a restart.
+    Deposited on the stall ring (so every flight dump carries it, with
+    the attempt's telemetry tail when available) — flight dumps can now
+    distinguish a warm-start price war (eps pinned at 1, supersteps >=
+    the warm budget, solved instantly by a fresh restart) from genuine
+    non-convergence. Since the dirty-frontier refit landed these should
+    be RARE; a recurring stream of them means the carried prices are
+    being invalidated faster than the refit can repair them."""
+    reason = {
+        "kind": "warm_price_war",
+        "backend": backend,
+        "supersteps": int(supersteps),
+        "budget": int(budget),
+        "converged": False,
+        "eps": int(tel.col("eps")[-1]) if tel is not None and len(tel.rows) else 1,
+        "excess": int(tel.col("excess")[-1]) if tel is not None and len(tel.rows) else 0,
+        "active": int(tel.col("active")[-1]) if tel is not None and len(tel.rows) else 0,
+        "detail": (
+            f"warm attempt burned {int(supersteps)}/{int(budget)} supersteps "
+            f"without converging (price war); escaping to {escaped_to}"
+        ),
+    }
+    return note_stall(reason, tel)
+
+
 def recent_stalls() -> List[dict]:
     with _lock:
         return list(_stalls)

@@ -24,25 +24,41 @@ TPU-shaped implementation notes:
   CSR ordering of the residual entries as cumsum + gather
   (diff-at-row-boundaries) and a segmented max via
   lax.associative_scan — each tens of microseconds at 64k entries.
-- The CSR ordering depends only on arc endpoints, which change far less
-  often than costs/capacities; it is cached and rebuilt on the host
-  (cheap numpy argsort) only when the arc structure changes.
+- The CSR ordering depends only on arc endpoints. For plain array
+  problems it is cached and rebuilt on the host (numpy argsort) when
+  the structure changes; problems that carry a slot-stable plan
+  (graph/slot_plan.py — every DeviceGraphState problem) skip the host
+  rebuild entirely: endpoint churn mutates O(1) maintained plan rows,
+  shipped as packed records through one jit'd scatter (a node that
+  out-churns its region relocates to a tail-pool span the same way),
+  and the argsort survives only on full_build / pow2 growth /
+  tail-pool exhaustion.
 - Everything is int32: TPU v5e has no native int64 (emulation trips XLA
   scoped-vmem issues and is slow). Scaled costs |c|*N must fit int32
   (checked on entry); potentials are guarded against overflow.
 - Shapes are static per padded generation (power-of-two growth in
   DeviceGraphState), so repeated rounds reuse one compiled executable.
 
-Incremental warm start (the property Flowlessly's daemon mode provides):
-the previous round's flow is carried over (dropped on arc slots whose
-endpoints changed), and a price-tightening pass — synchronous
-Bellman-Ford over residual reduced costs, a handful of sweeps for these
-shallow graphs — re-derives consistent potentials before every solve.
-That removes cross-round potential drift entirely (stale prices after
-capacity changes otherwise blow up relabel chains), lets the discharge
-run at eps=1 (exact, since costs are pre-scaled by the node count), and
-makes re-solve cost track the delta. Cost-scaling from max-cost remains
-as a fallback when the eps=1 attempt exceeds its superstep budget.
+Incremental warm start (the property Flowlessly's daemon mode
+provides), JOURNAL-SCOPED since r12: the change journal decides which
+warm state each round may carry. Node potentials always carry — on
+rounds whose journal holds only cap/cost/excess changes, the warm
+prologue REFITS them (the tightening Bellman sweep seeded with the
+carried prices moves only the journal-dirty frontier) and the carried
+flow discharges at eps=1 in a handful of supersteps. Carried FLOW,
+however, is kept only when the journal re-wired NO arc endpoints:
+an endpoint-churn round's optimum displaces carried flow, and
+discharging displaced excess is the measured unit-relabel price war
+(600-4,000 supersteps at 1% churn — and measured NOT fixable by
+price quality: exact entry prices, deeper Bellman budgets, warm eps
+ladders, and periodic global relabels all leave or worsen it, see
+_solve_mcmf). Those rounds dispatch the fresh-restart program up
+front — zero flow, tightened prices, eps=1, ~10 supersteps on these
+graphs — the same program the old `restart_budget` escape reached
+only after burning a doomed warm attempt. Cost-scaling from max-cost
+remains the final fallback, and `restart_budget` still backstops the
+kept-flow warm attempts (a budget blow is reported as a structured
+`warm_price_war` soltel event).
 """
 
 from __future__ import annotations
@@ -152,7 +168,7 @@ def _seg_min(vals, isstart, node_last, node_nonempty, identity):
 _BIG_D = 1 << 28  # "unreachable" distance sentinel for price tightening
 
 
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap", "use_warm_p"))
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap", "use_warm_p", "slot_stable"))
 def _solve_mcmf(
     cap, cost, supply, flow0, eps_init,
     s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, inv_order,
@@ -163,6 +179,7 @@ def _solve_mcmf(
     tighten_sweeps: int = 32,
     telemetry_cap: int = 0,
     use_warm_p: bool = False,
+    slot_stable: bool = False,
 ):
     """telemetry_cap > 0 appends a superstep-indexed int32 telemetry
     ring [telemetry_cap, SOLTEL_WIDTH] to the returned tuple (row
@@ -172,19 +189,57 @@ def _solve_mcmf(
     this traces the exact pre-telemetry jaxpr (no cost when off;
     pinned by the jaxpr contracts).
 
-    use_warm_p=True starts the discharge from the caller-supplied
-    ``warm_p`` potentials (the previous round's device-resident prices)
-    instead of running the tightening pass — the saturate step restores
-    0-optimality w.r.t. ANY price function, so the result is still an
-    exact optimum; only the trajectory (and thus which optimum, under
-    ties) differs. With the defaults (None, False) the traced program
-    is byte-identical to the pre-warm_p jaxpr: warm_p=None contributes
-    no invars and the tighten branch traces exactly as before (the
-    pinned off-hash contracts depend on that)."""
+    use_warm_p=True REFITS the caller-supplied ``warm_p`` potentials
+    (the previous round's device-resident prices) instead of running
+    the from-scratch tightening pass: the same Bellman sweep loop is
+    seeded with d0 = -warm_p, so the first sweep only moves nodes with
+    a violated residual out-arc — exactly the journal-touched dirty
+    frontier — and later sweeps expand that frontier until the prices
+    are consistent again (or the sweep budget runs out; the saturate
+    step then restores 0-optimality regardless, so the result is an
+    exact optimum either way). Because last round's converged prices
+    certify last round's flow, violations exist only around the churn,
+    which is what kills the warm-start price war: the discharge starts
+    eps-optimal-ish and drains in fresh-restart-like superstep counts
+    instead of unit-relabel wars. With the defaults (None, False) the
+    traced program is byte-identical to the pre-warm_p jaxpr: warm_p=
+    None contributes no invars and the tighten branch traces exactly
+    as before (the pinned off-hash contracts depend on that).
+
+    slot_stable=True consumes a scatter-maintained slot-stable plan
+    (graph/slot_plan.py): entry rows live in fixed per-node regions
+    with slack, and liveness is encoded in the sign column (s_sign in
+    {+1, -1, 0}) — the residual of a dead row is forced to 0, which
+    makes it inert in every reduction (no separate mask tensor). The
+    default (False) keeps the tightly-packed build_csr_plan layout and
+    traces the exact pre-slot-stable program.
+
+    Discharging DISPLACED excess through carried flow is structurally
+    slow here, and no price seeding fixes it (measured, r12): with the
+    prologue tighten CONVERGED (exact prices — raising its sweep cap
+    changes nothing), a churn round's warm attempt still drains its
+    bulk excess in ~20 supersteps and then strands the last displaced
+    units in a unit-relabel crawl for hundreds-to-thousands of steps —
+    the displacement chains are discovered one eps-relabel at a time,
+    and a periodic mid-discharge global relabel makes it WORSE (10x,
+    measured: re-tightening un-does the relabel progress that IS the
+    chain discovery). That is why JaxSolver keeps carried flow only on
+    journal-rounds with no endpoint churn (see its docstring)."""
     from ..obs.soltel import SOLTEL_WIDTH
 
     m = cap.shape[0]
     i32 = jnp.int32
+
+    def residual(a_flow):
+        """Residual per sorted entry; in slot-stable mode a dead row
+        (sign 0) gets residual 0 and thus cannot push, relabel, carry
+        excess, or consume prefix allocation."""
+        if slot_stable:
+            return jnp.where(
+                s_sign > 0, cap[s_arc] - a_flow,
+                jnp.where(s_sign < 0, a_flow, i32(0)),
+            )
+        return jnp.where(s_sign > 0, cap[s_arc] - a_flow, a_flow)
 
     def excess_of(flow):
         flow_signed = s_sign * flow[s_arc]
@@ -203,18 +258,26 @@ def _solve_mcmf(
     cap_src = s_src[fwd_pos]
     cap_dst = s_dst[fwd_pos]
 
-    def tighten(flow):
+    def tighten(flow, d0=None):
         """Price tightening: p = -(shortest residual-cost distance to a
         demand node), via synchronous Bellman-Ford sweeps over the sorted
         entries. Afterwards every residual arc between reachable nodes
         has nonnegative reduced cost, so the discharge can run at eps=1
         regardless of how flows/capacities changed since the last round —
-        this is what makes warm restarts cheap and drift-free."""
-        excess0 = excess_of(flow)
+        this is what makes warm restarts cheap and drift-free.
+
+        With an explicit ``d0`` this is the warm-prologue REFIT instead:
+        seeded from the carried prices, the relaxation only moves nodes
+        whose residual out-arcs are violated (the dirty frontier), and
+        the `changed` early-exit stops as soon as the frontier drains —
+        a bounded Bellman sweep over the journal-touched subgraph,
+        expressed data-parallel."""
+        excess0 = excess_of(flow) if d0 is None else None
         a_flow = flow[s_arc]
-        r = jnp.where(s_sign > 0, cap[s_arc] - a_flow, a_flow)
+        r = residual(a_flow)
         s_cost = s_sign * cost[s_arc]
-        d0 = jnp.where(excess0 < 0, i32(0), i32(_BIG_D))
+        if d0 is None:
+            d0 = jnp.where(excess0 < 0, i32(0), i32(_BIG_D))
 
         def t_cond(state):
             _d, changed, it = state
@@ -235,7 +298,7 @@ def _solve_mcmf(
 
     def superstep(flow, p, eps, excess):
         a_flow = flow[s_arc]
-        r = jnp.where(s_sign > 0, cap[s_arc] - a_flow, a_flow)
+        r = residual(a_flow)
         s_cost = s_sign * cost[s_arc]
         rc = s_cost + p[s_src] - p[s_dst]
         e_at = excess[s_src]
@@ -326,7 +389,15 @@ def _solve_mcmf(
 
         return lax.cond(any_active, do_superstep, next_phase, operand=None)
 
-    p0 = warm_p if use_warm_p else tighten(flow0)
+    if use_warm_p:
+        # dirty-frontier refit: Bellman sweeps seeded from the carried
+        # prices (clipped into tighten's distance range so the relax
+        # arithmetic cannot overflow int32)
+        p0 = tighten(
+            flow0, d0=jnp.clip(-warm_p, -i32(_BIG_D), i32(_BIG_D))
+        )
+    else:
+        p0 = tighten(flow0)
     flow1 = saturate(flow0, p0)  # mop up any residual violations
     state = (flow1, p0, eps_init, i32(0), jnp.bool_(False))
     if telemetry_cap:
@@ -352,26 +423,57 @@ class JaxSolver(FlowSolver):
     device array (masked against the pre-delta endpoints by the
     scatter-free ``device_warm_flow_fn`` program), bit-identical to the
     host warm path. Node potentials are likewise kept device-resident;
-    with ``warm_potentials=True`` the warm attempt starts from them
-    instead of re-running the tightening pass (an exact solve either
-    way — under cost ties the two trajectories may pick different
-    optima, which is why the default stays False: loop-mode and
-    export-arm parity tests compare placements bit-for-bit)."""
+    with ``warm_potentials=True`` (default) a kept-flow warm attempt
+    REFITS the carried prices around the journal-touched subgraph
+    instead of re-deriving them from scratch — an exact solve either
+    way. ``journal_scoped_warm=True`` (default) decides PER ROUND
+    whether the carried flow itself is reusable: only when the round's
+    journal re-wired no endpoints (see the module docstring for the
+    measured price-war evidence behind that rule). Every loop mode /
+    export arm shares the same policy, so the bit-for-bit
+    placement-parity suites still hold.
 
-    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True, telemetry: Optional[int] = None, warm_potentials: bool = False, restart_budget: Optional[int] = None):
+    ``slot_stable=True`` (default) consumes the scatter-maintained
+    slot-stable plan when the problem carries one
+    (graph/slot_plan.py): endpoint churn then never costs a host
+    argsort or a full plan re-upload — the plan deltas ride the same
+    dirty-slot journal as the problem deltas. Plain array problems
+    (no plan handle) keep the legacy host-built CsrPlan."""
+
+    def __init__(self, alpha: int = 8, max_supersteps: int = 50_000, warm_start: bool = True, telemetry: Optional[int] = None, warm_potentials: bool = True, restart_budget: Optional[int] = None, slot_stable: bool = True, journal_scoped_warm: bool = True):
         from .layered import validate_alpha
 
         self.alpha = validate_alpha(alpha)
         self.max_supersteps = max_supersteps
         self.warm_start = warm_start
         self.warm_potentials = warm_potentials
+        self.slot_stable = slot_stable
+        #: journal-scoped warm restart (default): the change journal
+        #: decides WHICH warm state each round may carry. Prices are
+        #: always reusable — the refit repairs them around whatever
+        #: the journal touched — but carried FLOW is kept only when
+        #: the journal holds no endpoint changes (plan_key match).
+        #: An endpoint-churn round deletes/rewires arcs, so its
+        #: optimum displaces carried flow, and discharging displaced
+        #: excess is the measured unit-relabel price war (600-4,000
+        #: supersteps at 1% churn; exact entry prices, deeper Bellman
+        #: budgets, eps ladders, and periodic global relabels all
+        #: measured NOT to fix it — see _solve_mcmf's docstring).
+        #: Those rounds dispatch the fresh-restart program up front
+        #: (zero flow, tightened prices, eps=1: ~10 supersteps on
+        #: these graphs) instead of burning a doomed warm attempt.
+        #: False restores the r11 policy (always attempt the carried
+        #: flow; rely on restart_budget to escape).
+        self.journal_scoped_warm = journal_scoped_warm
         #: superstep budget for the WARM attempt before escaping to a
         #: fresh-restart solve (flow0=0, tightened prices, eps=1 — the
         #: ~10-superstep machine on these graphs) instead of burning
         #: the full 4096-step attempt-1 budget. None keeps the original
-        #: two-attempt ladder. Measured at 10k×1k/1% churn: warm
-        #: price-war rounds cost 600-3000 supersteps; with a 256-step
-        #: budget they cost ≤ 256 + ~10 (BENCH_PIPELINE_r11.json).
+        #: two-attempt ladder. Since the dirty-frontier refit landed
+        #: this is a BACKSTOP, not the fix: refitted warm attempts
+        #: converge in fresh-restart-like superstep counts, and a
+        #: budget blow is reported as a structured `warm_price_war`
+        #: soltel event before escaping.
         self.restart_budget = restart_budget
         #: telemetry ring capacity override; None = the soltel module
         #: default (0 when KSCHED_SOLTEL=0 — telemetry off, identical
@@ -385,13 +487,24 @@ class JaxSolver(FlowSolver):
         #: of the latest refresh: a failed/degraded round still
         #: refreshes the mirror, and masking against its endpoints
         #: would miss changes from the round the solver never saw
-        #: (the host path gets this via prev_plan's endpoints)
         self._prev_src_dev = None
         self._prev_dst_dev = None
+        #: same endpoints as host arrays (the non-resident warm mask)
+        self._prev_src_host = None
+        self._prev_dst_host = None
         self._plan: Optional[CsrPlan] = None
         self._plan_dev: Optional[tuple] = None
+        #: endpoint-generation key of the cached plan
+        #: (FlowProblem.plan_key) — equal keys skip the O(M) endpoint
+        #: scans entirely on clean rounds
+        self._plan_key = None
+        #: endpoint key AT THE LAST SUCCESSFUL SOLVE — the journal-
+        #: scoped warm policy keeps carried flow only when the current
+        #: problem's key matches (no endpoint churn since that solve)
+        self._key_solved = None
         self.last_supersteps = 0
         self.last_telemetry = None  # SolveTelemetry of the last solve
+        self.last_warm_scope = "cold"  # warm | fresh | cold (see solve_async)
 
     def reset(self) -> None:
         self._prev = None
@@ -399,10 +512,15 @@ class JaxSolver(FlowSolver):
         self._prev_p = None
         self._prev_src_dev = None
         self._prev_dst_dev = None
+        self._prev_src_host = None
+        self._prev_dst_host = None
+        self._key_solved = None
 
-    def _plan_for(self, src: np.ndarray, dst: np.ndarray, n: int) -> tuple:
+    def _plan_for(self, src: np.ndarray, dst: np.ndarray, n: int, plan_key=None) -> tuple:
         plan = self._plan
-        if plan is None or len(plan.src) != len(src) or len(plan.node_first) != n or not (
+        if plan_key is not None and self._plan_key == plan_key and plan is not None:
+            return self._plan_dev  # generation key match: no scans at all
+        if plan is None or len(plan.src) != len(src) or len(plan.node_first) != n or plan_key is not None or not (
             np.array_equal(plan.src, src) and np.array_equal(plan.dst, dst)
         ):
             plan = build_csr_plan(src, dst, n)
@@ -417,6 +535,7 @@ class JaxSolver(FlowSolver):
             )
             # Structure changed: stale flows are only reusable per-slot if
             # endpoints match, checked in solve().
+        self._plan_key = plan_key
         return self._plan_dev
 
     def solve_async(self, problem: FlowProblem):
@@ -434,8 +553,8 @@ class JaxSolver(FlowSolver):
                 raise RuntimeError("infeasible flow problem: supply but no arcs")
             return (problem, None, None, None)
         check_finite_costs(problem)
-        src = problem.src.astype(np.int32)
-        dst = problem.dst.astype(np.int32)
+        src = np.asarray(problem.src, np.int32)
+        dst = np.asarray(problem.dst, np.int32)
 
         # Pre-scale costs by the node count so eps = 1 implies exactness;
         # the scaled range must fit int32 comfortably.
@@ -446,13 +565,37 @@ class JaxSolver(FlowSolver):
                 "rescale cost-model outputs or shrink the graph padding"
             )
 
-        prev_plan = self._plan
-        plan_dev = self._plan_for(src, dst, n)
+        plan_state = getattr(problem, "plan", None) if self.slot_stable else None
+        slot_stable = plan_state is not None
+        if slot_stable:
+            # slot-stable plan: endpoint churn was already folded into
+            # the maintained layout — no argsort, no endpoint scans.
+            # Prefer the device-resident scatter-maintained mirror;
+            # otherwise the plan's own cached full upload (re-shipped
+            # only when its value_version moved).
+            d_plan = getattr(problem, "d_plan", None)
+            plan_dev = d_plan if d_plan is not None else plan_state.device_args()
+        else:
+            plan_dev = self._plan_for(
+                src, dst, n, plan_key=getattr(problem, "plan_key", None)
+            )
 
         from ..obs import soltel
 
         tel_cap = soltel.resolve_cap(self.telemetry)
         resident = getattr(problem, "d_cap", None) is not None
+        # Journal-scoped warm restart: the endpoint generation key says
+        # whether this round's journal re-wired any arc. If it did, the
+        # optimum displaces carried flow and the warm discharge is the
+        # measured unit-relabel price war — dispatch the fresh-restart
+        # program (~10 supersteps) up front instead. Carried PRICES
+        # survive either way (the refit repairs them on clean rounds).
+        plan_key = getattr(problem, "plan_key", None)
+        keep_flow = True
+        if self.journal_scoped_warm and plan_key is not None:
+            keep_flow = (
+                self._key_solved is not None and plan_key == self._key_solved
+            )
         if resident:
             # Device-resident problem: the folded arrays are already on
             # device (only this round's delta records crossed the
@@ -464,7 +607,7 @@ class JaxSolver(FlowSolver):
 
             dev_args, flow0_dev, warm = resident_solver_inputs(
                 problem, self._prev_dev, self._prev_src_dev,
-                self._prev_dst_dev, self.warm_start,
+                self._prev_dst_dev, self.warm_start and keep_flow,
             )
         else:
             cap = problem.cap.astype(np.int32)
@@ -475,19 +618,35 @@ class JaxSolver(FlowSolver):
             )
             warm = (
                 self.warm_start
+                and keep_flow
                 and self._prev is not None
                 and len(self._prev) == m
-                and prev_plan is not None
-                and len(prev_plan.src) == m
+                and self._prev_src_host is not None
+                and len(self._prev_src_host) == m
             )
             flow0 = np.zeros(m, dtype=np.int32)
             if warm:
-                # Reuse prior flow where the arc endpoints are unchanged;
-                # price tightening inside the solve re-derives consistent
-                # potentials, so flow is the only warm state needed.
-                same = (prev_plan.src == src) & (prev_plan.dst == dst)
-                flow0 = np.where(same, np.minimum(self._prev, cap), 0).astype(np.int32)
+                # Reuse prior flow where the arc endpoints are unchanged
+                # since the last SUCCESSFUL solve; the refit/tighten
+                # prologue inside the solve restores consistent prices.
+                # (With a matched plan_key the mask is all-ones by
+                # construction; plain-array problems carry no key, so
+                # the journal-scoped policy falls back to this compare.)
+                same = (self._prev_src_host == src) & (self._prev_dst_host == dst)
+                if self.journal_scoped_warm and plan_key is None and not same.all():
+                    warm = False
+                    flow0 = np.zeros(m, dtype=np.int32)
+                else:
+                    flow0 = np.where(same, np.minimum(self._prev, cap), 0).astype(np.int32)
             flow0_dev = jnp.asarray(flow0)
+        had_state = self._prev is not None or self._prev_dev is not None
+        #: per-solve warm scope, for bench/obs accounting: "warm" =
+        #: carried flow + refit prices, "fresh" = journal-scoped
+        #: restart (endpoint churn; zero flow, tightened prices),
+        #: "cold" = no carried state at all (first round / post-reset)
+        self.last_warm_scope = (
+            "warm" if warm else ("fresh" if had_state else "cold")
+        )
 
         # Attempt 1: warm flow, tightened prices (or, with
         # warm_potentials, the previous round's device-resident prices)
@@ -517,9 +676,11 @@ class JaxSolver(FlowSolver):
             max_supersteps=attempt1_budget,
             telemetry_cap=tel_cap,
             use_warm_p=warm_p_ok,
+            slot_stable=slot_stable,
         )
         cold = (np.zeros(m, dtype=np.int32), max(1, max_cost * n))
-        return (problem, fut, (dev_args, plan_dev, cold, tel_cap, warm), resident)
+        rest = (dev_args, plan_dev, cold, tel_cap, warm, slot_stable, attempt1_budget)
+        return (problem, fut, rest, resident)
 
     def complete(self, pending) -> FlowResult:
         """Synchronize a solve_async dispatch into a FlowResult."""
@@ -532,18 +693,41 @@ class JaxSolver(FlowSolver):
                 flow=np.zeros(len(problem.src), dtype=np.int64),  # kschedlint: host-only (FlowResult contract is int64)
                 objective=0, iterations=0,
             )
-        dev_args, plan_dev, (f0_cold, eps_cold), tel_cap, warm = rest
+        dev_args, plan_dev, (f0_cold, eps_cold), tel_cap, warm, slot_stable, attempt1_budget = rest
         tel_buf = None
         if tel_cap:
             flow, p, steps, converged, p_overflow, tel_buf = fut
         else:
             flow, p, steps, converged, p_overflow = fut
         spent = int(steps)  # device work across ALL attempts this solve
-        if (
-            not (bool(converged) and not bool(p_overflow))
-            and warm
-            and self.restart_budget is not None
-        ):
+        warm_failed = warm and not (bool(converged) and not bool(p_overflow))
+        if warm_failed and not bool(converged):
+            # A warm attempt that exhausted its budget is a price war,
+            # not a hard instance (the fresh restart below converges in
+            # ~10 supersteps): report it as a structured soltel event so
+            # flight dumps distinguish it from genuine non-convergence.
+            # A CONVERGED attempt that tripped the potential-overflow
+            # guard still escapes below, but is NOT a price war — and
+            # must not masquerade as one on the stall ring.
+            soltel.warm_price_war(
+                "jax",
+                supersteps=int(steps),
+                budget=attempt1_budget,
+                escaped_to=(
+                    "fresh_restart" if self.restart_budget is not None
+                    else "cost_scaling"
+                ),
+                tel=(
+                    soltel.decode(
+                        tel_buf, int(steps), tel_cap, "jax", attempt1_budget,
+                        converged=False,
+                        nodes=problem.num_nodes, arcs=len(problem.src),
+                    )
+                    if tel_buf is not None
+                    else None
+                ),
+            )
+        if warm_failed and self.restart_budget is not None:
             # Attempt 1b (restart escape): a warm attempt that blew its
             # budget re-solves FRESH — zero flow, tightened prices,
             # eps=1 — the ~10-superstep path on these graphs, instead
@@ -558,6 +742,7 @@ class JaxSolver(FlowSolver):
                 alpha=self.alpha,
                 max_supersteps=min(4096, self.max_supersteps),
                 telemetry_cap=tel_cap,
+                slot_stable=slot_stable,
             )
             if tel_cap:
                 flow, p, steps, converged, p_overflow, tel_buf = out
@@ -573,6 +758,7 @@ class JaxSolver(FlowSolver):
                 alpha=self.alpha,
                 max_supersteps=self.max_supersteps,
                 telemetry_cap=tel_cap,
+                slot_stable=slot_stable,
             )
             if tel_cap:
                 flow, p, steps, converged, p_overflow, tel_buf = out
@@ -623,6 +809,13 @@ class JaxSolver(FlowSolver):
             self._prev_dev = flow if resident else None
             self._prev_src_dev = problem.d_src if resident else None
             self._prev_dst_dev = problem.d_dst if resident else None
+            # host-side endpoints at this (successful) solve, for the
+            # non-resident warm mask; problem arrays are snapshots
+            self._prev_src_host = np.asarray(problem.src, np.int32)
+            self._prev_dst_host = np.asarray(problem.dst, np.int32)
+            # endpoint key at this solve: the journal-scoped warm
+            # policy compares the next round's key against it
+            self._key_solved = getattr(problem, "plan_key", None)
             self._prev_p = p
         objective = int(
             (flow_np.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)

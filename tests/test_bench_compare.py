@@ -80,6 +80,44 @@ def test_gate_skips_fallback_vs_device_baseline(tmp_path):
     assert _gate(p).returncode == 0
 
 
+def test_gate_ratchets_supersteps_p50(tmp_path):
+    """Series carrying supersteps_p50 ratchet it alongside latency: a
+    warm-start price war creeping back (10 → 600 supersteps) fails the
+    gate even when the idle-CPU wall clock stayed flat."""
+    p = tmp_path / "traj.jsonl"
+    _write(p, [
+        _entry("churn", 10.0, supersteps_p50=10),
+        _entry("churn", 10.0, supersteps_p50=600),
+    ])
+    r = _gate(p)
+    assert r.returncode == 1
+    assert "supersteps_p50" in r.stderr and "price war" in r.stderr
+
+
+def test_gate_supersteps_slack_absorbs_quantization(tmp_path):
+    """Small integer jitter near the healthy ~10 band is quantization,
+    not regression: +25% relative alone (10 → 13) must pass — the
+    absolute slack gates it out."""
+    p = tmp_path / "traj.jsonl"
+    _write(p, [
+        _entry("churn", 10.0, supersteps_p50=10),
+        _entry("churn", 10.0, supersteps_p50=13),
+    ])
+    assert _gate(p).returncode == 0
+
+
+def test_gate_supersteps_absent_is_not_gated(tmp_path):
+    """A series without the field (non-churn configs) never trips the
+    supersteps ratchet, and a series that only just gained it has no
+    baseline to compare against."""
+    p = tmp_path / "traj.jsonl"
+    _write(p, [
+        _entry("a", 10.0),
+        _entry("a", 10.5, supersteps_p50=9),
+    ])
+    assert _gate(p).returncode == 0
+
+
 def test_gate_single_entry_series_passes(tmp_path):
     p = tmp_path / "traj.jsonl"
     _write(p, [_entry("a", 10.0), _entry("b", 5.0)])

@@ -421,10 +421,12 @@ def test_delta_apply_scatters_and_is_32bit():
 
 
 def test_delta_apply_exemption_is_scoped():
-    """The exemption covers EXACTLY ONE program: every registered
-    solver backend still traces zero scatters (the existing per-backend
-    sweep re-asserted here so the exemption test and the zero-scatter
-    rule can never pass for contradictory reasons)."""
+    """The exemptions cover EXACTLY TWO programs (the problem-delta
+    apply and the slot-stable plan apply, both once-per-round
+    maintenance outside any solve): every registered solver backend
+    still traces zero scatters (the existing per-backend sweep
+    re-asserted here so the exemption tests and the zero-scatter rule
+    can never pass for contradictory reasons)."""
     for backend in jc.REGISTERED_BACKENDS:
         report = jc.backend_report(backend, 20, 100)
         assert report.ok_scatter, (backend, report.scatter_eqns)
@@ -462,11 +464,11 @@ def test_warm_flow_program_is_elementwise():
 
 
 def test_warmp_trace_is_distinct_and_scatter_free():
-    """use_warm_p=True is a DIFFERENT traced program (it consumes the
-    warm potentials and skips tighten) — still zero scatters, no
-    64-bit, pow2-bucket stable. The DEFAULT trace staying on the
-    pinned pre-warm_p baseline is asserted by
-    test_soltel_off_trace_is_the_pretelemetry_baseline."""
+    """use_warm_p=True is a DIFFERENT traced program — since the
+    dirty-frontier refit it consumes the carried potentials as the
+    Bellman seed — still zero scatters, no 64-bit, pow2-bucket stable.
+    The DEFAULT trace staying on the pinned pre-warm_p baseline is
+    asserted by test_soltel_off_trace_is_the_pretelemetry_baseline."""
     closed = jc.trace_jax_warmp(20, 100)
     report = jc.check_jaxpr("jax+warmp", closed)
     assert report.ok_scatter and report.ok_64bit
@@ -474,6 +476,74 @@ def test_warmp_trace_is_distinct_and_scatter_free():
     assert jc.jaxpr_hash(jc.trace_jax_warmp(20, 100)) == jc.jaxpr_hash(
         jc.trace_jax_warmp(24, 110)
     )
+
+
+# ---------------------------------------------------------------------------
+# Slot-stable plan maintenance: the SECOND scoped scatter exemption
+# ---------------------------------------------------------------------------
+
+
+def test_plan_apply_scatters_and_is_32bit():
+    """The plan-row apply program IS allowed scatters — it applies the
+    round's O(churn)-sized dirty plan rows + inv-order records once per
+    round — and the exemption must not be vacuous: the traced program
+    really contains scatter ops. Everything stays 32-bit."""
+    report = jc.check_jaxpr("plan_apply", jc.trace_plan_apply(5, 3))
+    assert report.scatter_eqns, (
+        "the plan-apply trace contains no scatters — the scoped "
+        "exemption is vacuous (did the program change shape?)"
+    )
+    assert report.ok_64bit, report.violations_64bit
+
+
+def test_plan_apply_pow2_record_bucket_hash_stable():
+    """Two record counts sharing a pow2 bucket trace byte-identical
+    plan-apply programs (one compiled scatter per bucket); cross-bucket
+    hashes differ (the check isn't vacuous). The graph bucket behaves
+    the same way."""
+    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2)) == jc.jaxpr_hash(
+        jc.trace_plan_apply(7, 5)
+    )
+    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2)) != jc.jaxpr_hash(
+        jc.trace_plan_apply(100, 2)
+    )
+    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2, n_raw=20, m_raw=100)) == jc.jaxpr_hash(
+        jc.trace_plan_apply(3, 2, n_raw=24, m_raw=110)
+    )
+    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2, n_raw=20, m_raw=100)) != jc.jaxpr_hash(
+        jc.trace_plan_apply(3, 2, n_raw=20, m_raw=300)
+    )
+
+
+def test_slot_stable_trace_is_distinct_scatter_free_and_bucket_stable():
+    """slot_stable=True is a DIFFERENT traced program (dead rows are
+    masked through the sign column) but still a SOLVE program: zero
+    scatters, no 64-bit, and hash-stable within a pow2 bucket (the
+    entry extent is a function of the m-bucket, never the raw size —
+    a raw-size leak here would mean a recompile per region rebuild)."""
+    closed = jc.trace_jax_slot_stable(20, 100)
+    report = jc.check_jaxpr("jax+slot_stable", closed)
+    assert report.ok_scatter, report.scatter_eqns
+    assert report.ok_64bit, report.violations_64bit
+    assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.traced("jax", 20, 100))
+    assert jc.jaxpr_hash(jc.trace_jax_slot_stable(20, 100)) == jc.jaxpr_hash(
+        jc.trace_jax_slot_stable(24, 110)
+    )
+    assert jc.jaxpr_hash(jc.trace_jax_slot_stable(20, 100)) != jc.jaxpr_hash(
+        jc.trace_jax_slot_stable(20, 300)
+    )
+
+
+def test_refit_slot_stable_combo_is_scatter_free():
+    """The production event-path program — dirty-frontier refit ON TOP
+    of the slot-stable plan (use_warm_p=True, slot_stable=True) — must
+    also stay scatter-free and 32-bit: the refit is plain data-parallel
+    Bellman relaxation over the maintained layout."""
+    closed = jc.trace_jax_warmp(20, 100, slot_stable=True)
+    report = jc.check_jaxpr("jax+refit+slot_stable", closed)
+    assert report.ok_scatter, report.scatter_eqns
+    assert report.ok_64bit, report.violations_64bit
+    assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.trace_jax_warmp(20, 100))
 
 
 # ---------------------------------------------------------------------------

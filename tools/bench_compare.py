@@ -10,6 +10,15 @@ more than the tolerance vs its PREVIOUS entry — the committed
 equivalent of "don't merge a p50 regression", enforceable without
 re-running the bench in CI.
 
+The gate ratchets TWO axes per (config, platform) series: wall-clock
+`p50_ms`, and — for entries that carry it (the churn/event-path
+series) — `supersteps_p50`, the solver-work-per-round measure that
+wall clock alone can hide on a fast host (a warm-start price war that
+burns 600+ supersteps still finishes in milliseconds on an idle CPU,
+then detonates under load). Supersteps get a relative tolerance plus
+a small absolute slack, since healthy values sit near ~10 where ±
+a-few is quantization, not regression.
+
 Cross-platform readings don't gate each other: entries compare only
 within the same (config, platform) series, and entries stamped
 `accelerator_unreachable` are never used as a baseline for device
@@ -33,6 +42,11 @@ import time
 from typing import List, Optional
 
 DEFAULT_TOLERANCE = 0.15
+#: supersteps ratchet: relative tolerance + absolute slack (healthy
+#: churn-series values are ~10; integer jitter of a few steps is
+#: quantization, a jump past ~25% AND +8 is a warm-start regression)
+SUPERSTEPS_TOLERANCE = 0.25
+SUPERSTEPS_SLACK = 8
 
 
 def _git_commit() -> str:
@@ -184,6 +198,32 @@ def gate_cmd(args) -> int:
                 f"(+{ratio:.1%} > {args.tolerance:.0%} tolerance; "
                 f"{prev.get('commit')} -> {last.get('commit')})"
             )
+        # supersteps ratchet: only when BOTH entries carry the field
+        # (the churn/event-path series); regression requires blowing
+        # the relative tolerance AND the absolute slack
+        if prev.get("supersteps_p50") is not None and last.get(
+            "supersteps_p50"
+        ) is not None:
+            s_prev = float(prev["supersteps_p50"])
+            s_last = float(last["supersteps_p50"])
+            s_ratio = (s_last - s_prev) / max(s_prev, 1e-9)
+            bad = (
+                s_ratio > args.supersteps_tolerance
+                and s_last - s_prev > SUPERSTEPS_SLACK
+            )
+            print(
+                f"{tag:<40} ss  {s_prev:9.0f} -> {s_last:9.0f}    "
+                f"({s_ratio:+8.1%})  {'REGRESSED' if bad else 'OK'}"
+            )
+            if bad:
+                failures.append(
+                    f"{tag}: supersteps_p50 {s_prev:.0f} -> {s_last:.0f} "
+                    f"(+{s_ratio:.1%} > {args.supersteps_tolerance:.0%} "
+                    f"tolerance and +{s_last - s_prev:.0f} > "
+                    f"{SUPERSTEPS_SLACK} slack; warm-start price war "
+                    f"creeping back? {prev.get('commit')} -> "
+                    f"{last.get('commit')})"
+                )
     if not checked:
         print("gate: no series has two comparable entries yet (pass)")
         return 0
@@ -228,6 +268,11 @@ def main() -> int:
     ap_gate.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                          help="max allowed relative p50 increase "
                          "(default 0.15)")
+    ap_gate.add_argument("--supersteps-tolerance", type=float,
+                         default=SUPERSTEPS_TOLERANCE,
+                         help="max allowed relative supersteps_p50 "
+                         "increase for series that carry it "
+                         "(default 0.25; +8 absolute slack)")
     ap_gate.set_defaults(fn=gate_cmd)
     ap_show = sub.add_parser("show", help="tabulate the trajectory")
     ap_show.add_argument("trajectory")
