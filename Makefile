@@ -17,6 +17,12 @@
 #                     pipelined, and pipelined+device-resident service
 #                     loops, including mid-flight rung degradation
 #                     (docs/round_pipeline.md)
+#   make tenant-smoke 16-cell multi-tenant soak: one warm batched-solver
+#                     process, mixed cell sizes, chaos injected into ONE
+#                     tenant — asserts per-tenant placements bit-identical
+#                     to each tenant run in isolation, zero cross-tenant
+#                     interference in the round trace, and reports
+#                     per-tenant p50/p99 (docs/multitenancy.md)
 #   make bench-gate   check BENCH_TRAJECTORY.jsonl: fail if any config's
 #                     newest p50 regressed >15% vs its previous entry,
 #                     or its supersteps_p50 regressed >25% (+8 slack)
@@ -32,7 +38,7 @@ SHELL := /bin/bash
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke bench-gate verify baseline
+.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke bench-gate verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
@@ -55,6 +61,10 @@ pipeline-smoke:
 	  --rounds 64 --chunk 32 --seed 5 --machines 6 --slots 8 \
 	  --chaos-restore-every 32 --verify-loop-parity
 
+tenant-smoke:
+	timeout -k 10 570 env JAX_PLATFORMS=cpu $(PY) tools/soak.py \
+	  --tenants 16 --rounds 40 --seed 0 --chaos-tenant 0
+
 bench-gate:
 	$(PY) tools/bench_compare.py gate BENCH_TRAJECTORY.jsonl
 
@@ -67,7 +77,7 @@ test:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-verify: lint test chaos-smoke obs-smoke pipeline-smoke
+verify: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke
 
 baseline:
 	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

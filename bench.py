@@ -967,6 +967,229 @@ def _churn_pipeline_bench(
     }
 
 
+def _multitenant_bench(
+    cells: int = 16,
+    rounds: int = 24,
+    warmup: int = 4,
+    restart_budget: int = 64,
+    verbose: bool = False,
+) -> dict:
+    """The multi-tenant scheduler-as-a-service benchmark (tenancy/):
+    N mixed-size cells served by ONE warm process, comparing
+
+    - ``batched``: every cell dispatches its round, then same-bucket
+      lanes solve through one stacked program per (bucket, policy)
+      group (solver/jax_solver.stacked_solve_fn) — the multi-tenant
+      service's hot path;
+    - ``sequential``: the same N cells solved one at a time, each by
+      its own plain JaxSolver — the one-process-per-tenant status quo
+      folded into a single loop (per-tenant warm state kept, so this
+      is the strongest sequential baseline, not a strawman).
+
+    The arms run the IDENTICAL seeded scenario (same per-cell id
+    streams, same churn draws) and are interleaved round-robin so
+    ambient drift hits both equally (paired, like the churn bench);
+    per-cell placements are asserted bit-identical across arms every
+    round — the batched stack must change WHERE lanes solve, never
+    what they compute. Cell sizes cycle 3 classes so the fleet spans
+    3 pow2 shape buckets; with per-lane warm scopes agreeing in
+    steady state the fleet solves in ~3 stacked programs per round
+    instead of N solver calls. On CPU the win is dispatch/compile-
+    cache amortization; the lane-axis vectorization gain is a device
+    property (UNMEASURED until a TPU ambient appears — same posture
+    as the mega/device claims)."""
+    import jax
+
+    from ksched_tpu.drivers import add_job, build_cluster
+    from ksched_tpu.drivers.synthetic import add_task_to_job
+    from ksched_tpu.solver.jax_solver import JaxSolver
+    from ksched_tpu.tenancy import LaneSolver, StackedBatcher
+    from ksched_tpu.utils import seed_rng
+    from ksched_tpu.utils.ids import rng as global_rng
+
+    #: (machines, tasks) per cell class — 3 classes -> 3 pow2 buckets
+    SIZES = ((12, 96), (24, 192), (48, 384))
+
+    class _Cell:
+        def __init__(self, idx: int, backend):
+            machines, tasks = SIZES[idx % len(SIZES)]
+            self.idx = idx
+            self.tasks = tasks
+            # per-cell id stream, IDENTICAL across arms: both arms'
+            # cell idx consumes the same seed's continuation
+            seed_rng(10_000 + idx)
+            self.backend = backend
+            (
+                self.sched, self.rmap, self.jmap, self.tmap, self.root,
+            ) = build_cluster(
+                num_machines=machines, num_cores=1, pus_per_core=4,
+                max_tasks_per_pu=4, backend=backend,
+            )
+            self.job_id = add_job(
+                self.sched, self.jmap, self.tmap, num_tasks=tasks
+            )
+            self.sched.schedule_all_jobs()  # fill solve (not measured)
+            self.rng = np.random.default_rng(500 + idx)
+            self.k = max(1, tasks // 50)
+            self._rng_state = global_rng().getstate()
+
+        def swap_in(self):
+            self._outer = global_rng().getstate()
+            global_rng().setstate(self._rng_state)
+
+        def park(self):
+            self._rng_state = global_rng().getstate()
+            global_rng().setstate(self._outer)
+
+        def churn(self):
+            bound = sorted(self.sched.task_bindings.items())
+            idx = sorted(
+                int(x) for x in self.rng.choice(len(bound), self.k, replace=False)
+            )
+            for i in reversed(idx):
+                self.sched.handle_task_completion(self.tmap.find(bound[i][0]))
+            for _ in range(self.k):
+                add_task_to_job(self.job_id, self.jmap, self.tmap)
+            self.sched.add_job(self.jmap.find(self.job_id))
+
+        def placements(self):
+            return {
+                self.tmap.find(t).name: rid
+                for t, rid in self.sched.task_bindings.items()
+            }
+
+    batcher = StackedBatcher()
+    arms = {}
+    arms["batched"] = [
+        _Cell(i, LaneSolver(batcher, tenant=f"c{i}", restart_budget=restart_budget))
+        for i in range(cells)
+    ]
+    arms["sequential"] = [
+        _Cell(i, JaxSolver(slot_stable=False, restart_budget=restart_budget))
+        for i in range(cells)
+    ]
+    fleet_ms = {"batched": [], "sequential": []}
+    cell_ms = {
+        "batched": [[] for _ in range(cells)],
+        "sequential": [[] for _ in range(cells)],
+    }
+    ss_hist = {"batched": [], "sequential": []}
+    programs_per_round = []
+    for r in range(warmup + rounds):
+        snaps = {}
+        for label in ("batched", "sequential"):
+            fleet = arms[label]
+            t0 = time.perf_counter()
+            if label == "batched":
+                tokens = []
+                for cell in fleet:
+                    tc = time.perf_counter()
+                    cell.swap_in()
+                    cell.churn()
+                    tokens.append(cell.sched.schedule_all_jobs_async())
+                    cell.park()
+                    cell_ms[label][cell.idx].append(
+                        (time.perf_counter() - tc) * 1e3
+                    )
+                groups = batcher.flush()
+                for cell, token in zip(fleet, tokens):
+                    tc = time.perf_counter()
+                    if token is not None:
+                        cell.sched.finish_scheduling()
+                    cell_ms[label][cell.idx][-1] += (
+                        time.perf_counter() - tc
+                    ) * 1e3
+                if r >= warmup:
+                    programs_per_round.append(groups)
+            else:
+                for cell in fleet:
+                    tc = time.perf_counter()
+                    cell.swap_in()
+                    cell.churn()
+                    cell.sched.schedule_all_jobs()
+                    cell.park()
+                    cell_ms[label][cell.idx].append(
+                        (time.perf_counter() - tc) * 1e3
+                    )
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            snaps[label] = [c.placements() for c in fleet]
+            if r >= warmup:
+                fleet_ms[label].append(wall_ms)
+                ss_hist[label].append(
+                    sum(c.backend.last_supersteps for c in fleet)
+                )
+            else:
+                # warm-up rounds carry the compiles; drop their
+                # per-cell samples too so both stats cover the same
+                # measured window
+                for cell in fleet:
+                    cell_ms[label][cell.idx].pop()
+            if verbose:
+                print(
+                    f"# multitenant[{label}] round {r}: {wall_ms:.1f}ms",
+                    file=sys.stderr,
+                )
+        # bit-parity per cell per round: batching must never change a
+        # lane's answer
+        for i in range(cells):
+            assert snaps["batched"][i] == snaps["sequential"][i], (
+                f"round {r}: cell {i} placements diverged between the "
+                "batched and sequential arms"
+            )
+
+    def _arm_stats(label):
+        lat = fleet_ms[label]
+        per_cell = {
+            f"cell_{i}": {
+                "p50_ms": round(float(np.percentile(v, 50)), 3),
+                "p99_ms": round(float(np.percentile(v, 99)), 3),
+            }
+            for i, v in enumerate(cell_ms[label])
+            if v
+        }
+        return {
+            "fleet_p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "fleet_p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "fleet_mean_ms": round(float(np.mean(lat)), 3),
+            "supersteps_per_round_p50": int(np.percentile(ss_hist[label], 50)),
+            "per_tenant": per_cell,
+        }
+
+    out_arms = {label: _arm_stats(label) for label in fleet_ms}
+    b, s = out_arms["batched"], out_arms["sequential"]
+    return {
+        "metric": (
+            f"p50 fleet-round latency, {cells} cells (mixed sizes, 3 pow2 "
+            "buckets), batched stacked-CSR vs sequential-per-tenant, "
+            f"backend=lane/{jax.devices()[0].platform}"
+        ),
+        "value": b["fleet_p50_ms"],
+        "unit": "ms",
+        "vs_baseline": (
+            round(s["fleet_p50_ms"] / max(b["fleet_p50_ms"], 1e-9), 3)
+        ),
+        "detail": {
+            "arms": out_arms,
+            "placements_bit_identical_across_arms": True,
+            "p50_improvement_vs_sequential": round(
+                1.0 - b["fleet_p50_ms"] / s["fleet_p50_ms"], 3
+            ),
+            "stacked_programs_per_round_p50": int(
+                np.percentile(programs_per_round, 50)
+            ),
+            "lanes": cells,
+            "rounds": rounds,
+            "warmup_rounds": warmup,
+            "supersteps_p50": b["supersteps_per_round_p50"],
+            "note": (
+                "paired arms, same seeded scenario; CPU measures "
+                "dispatch/compile amortization only — lane-axis device "
+                "vectorization UNMEASURED (no TPU reachable)"
+            ),
+        },
+    }
+
+
 #: the five BASELINE.json benchmark configs plus the Quincy
 #: data-locality config (see run_config for each)
 SUITE_CONFIGS = (
@@ -975,7 +1198,7 @@ SUITE_CONFIGS = (
     "gtrace12k-coco",
 )
 #: configs runnable via --config but not part of the default suite
-EXTRA_CONFIGS = ("gtrace12k-host", "mcmf-mega", "churn")
+EXTRA_CONFIGS = ("gtrace12k-host", "mcmf-mega", "churn", "multitenant")
 
 
 def run_config(args) -> None:
@@ -1219,6 +1442,23 @@ def run_config(args) -> None:
             churn=float(pov.get("churn", 0.01)),
             restart_budget=int(pov.get("restart_budget", 64)),
             cold_control=bool(int(pov.get("cold_control", 1))),
+            verbose=args.verbose,
+        )
+        if pov:
+            out["detail"]["overrides"] = dict(sorted(pov.items()))
+    elif name == "multitenant":
+        # scheduler-as-a-service: N mixed-size cells through one warm
+        # batched solver vs sequential-per-tenant, paired arms with
+        # bit-identical placements asserted per cell per round
+        # (ksched_tpu/tenancy; docs/multitenancy.md)
+        pov = parse_overrides(
+            args.override, ("cells", "rounds", "warmup", "restart_budget")
+        )
+        out = _multitenant_bench(
+            cells=int(pov.get("cells", 16)),
+            rounds=int(pov.get("rounds", 24)),
+            warmup=int(pov.get("warmup", 4)),
+            restart_budget=int(pov.get("restart_budget", 64)),
             verbose=args.verbose,
         )
         if pov:

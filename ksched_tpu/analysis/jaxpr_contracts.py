@@ -523,6 +523,42 @@ def trace_plan_apply(
     )
 
 
+def trace_stacked(
+    lanes_raw: int,
+    n_raw: int,
+    m_raw: int,
+    telemetry_cap: int = 0,
+    use_warm_p: bool = False,
+):
+    """Abstract trace of the multi-tenant stacked-CSR batched solve
+    (solver/jax_solver.stacked_solve_fn): same-bucket tenant lanes
+    through one program, lane axis leading. Contracts pin it
+    scatter-free (vmap's while-loop batching masks converged lanes
+    with selects, never scatters), 32-bit, and hash-stable across raw
+    sizes within a pow2 shape bucket AND raw lane counts within a pow2
+    lane bucket — tenants joining/leaving must reuse executables."""
+    from ..solver.jax_solver import pad_lane_count, stacked_solve_fn
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    L = pad_lane_count(lanes_raw)
+    e = 2 * m
+    fn = stacked_solve_fn(
+        alpha=8, max_supersteps=4096, tighten_sweeps=32,
+        telemetry_cap=telemetry_cap, use_warm_p=use_warm_p,
+    )
+    args = [
+        _sds((L, m)), _sds((L, m)), _sds((L, n)), _sds((L, m)), _sds((L,)),
+    ]
+    if use_warm_p:
+        args.append(_sds((L, n)))
+    args += [
+        _sds((L, e)), _sds((L, e)), _sds((L, e)), _sds((L, e)), _sds((L, e)),
+        _sds((L, e), jnp.bool_), _sds((L, e)),
+        _sds((L, n)), _sds((L, n)), _sds((L, n), jnp.bool_),
+    ]
+    return jax.make_jaxpr(fn)(*args)
+
+
 def trace_delta_apply(ka_raw: int, kn_raw: int, n_raw: int = 20, m_raw: int = 100):
     """Abstract trace of the FIRST scatter-exempt program: the
     device-resident delta apply over pow2-bucketed record counts

@@ -78,6 +78,57 @@ class FlowProblem:
         return int(self.excess[self.excess > 0].sum())
 
 
+def pad_problem(problem: FlowProblem, n_cap: int, m_cap: int) -> FlowProblem:
+    """Zero-pad a FlowProblem into a LARGER pow2 shape bucket (the
+    multi-tenant lane-alignment helper, tenancy/batch.py).
+
+    Padding rows are inert by construction: pad nodes carry zero excess
+    and node_type -1, pad arc slots are (0, 0) self-loops at node 0
+    with zero cap/cost, whose forward AND backward residuals are zero —
+    they can never push, relabel, or absorb prefix allocation, so the
+    real prefix of the solved flow is unchanged by the padding.
+
+    One caveat the tenancy layer documents and tests: the general-graph
+    solvers pre-scale costs by ``num_nodes`` for eps=1 exactness, so a
+    padded problem is a DIFFERENT (equally exact) solve than the
+    unpadded one — bit-parity holds between runs that pad identically
+    (a lane vs the same lane solved alone at the same bucket), not
+    between a padded and an unpadded solve. Bucket assignment is
+    therefore a per-tenant property (its own caps + a static floor),
+    never a function of which co-tenants happen to share the process.
+    """
+    n0, m0 = problem.num_nodes, len(problem.src)
+    if n_cap < n0 or m_cap < m0:
+        raise ValueError(
+            f"pad_problem cannot shrink: ({n0}, {m0}) -> ({n_cap}, {m_cap})"
+        )
+    if n_cap == n0 and m_cap == m0:
+        return problem
+
+    def pad_to(arr, size, fill=0):
+        out = np.full(size, fill, dtype=arr.dtype)
+        out[: len(arr)] = arr
+        return out
+
+    return FlowProblem(
+        num_nodes=n_cap,
+        excess=pad_to(problem.excess, n_cap),
+        node_type=pad_to(problem.node_type, n_cap, fill=-1),
+        src=pad_to(problem.src, m_cap),
+        dst=pad_to(problem.dst, m_cap),
+        cap=pad_to(problem.cap, m_cap),
+        cost=pad_to(problem.cost, m_cap),
+        flow_offset=pad_to(problem.flow_offset, m_cap),
+        num_arcs=problem.num_arcs,
+        plan=None,  # slot-stable plans do not survive re-padding
+        plan_key=(
+            ("padded", problem.plan_key, n_cap, m_cap)
+            if problem.plan_key is not None
+            else None
+        ),
+    )
+
+
 _STATE_UIDS = itertools.count()
 
 

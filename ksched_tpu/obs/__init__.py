@@ -42,6 +42,7 @@ from .metrics import (
     NULL_METRIC,
     NULL_REGISTRY,
     Registry,
+    ScopedRegistry,
     enabled,
     get_registry,
     log_buckets,
@@ -68,6 +69,7 @@ __all__ = [
     "NULL_METRIC",
     "NULL_REGISTRY",
     "Registry",
+    "ScopedRegistry",
     "SOLTEL_COLS",
     "SOLTEL_DEFAULT_CAP",
     "SOLTEL_TAIL",

@@ -45,11 +45,18 @@ class FlightRecorder:
         dump_dir: str = ".",
         registry: Optional[Registry] = None,
         min_rounds_between_dumps: int = 16,
+        scope: str = "",
     ) -> None:
         if capacity <= 0:
             raise ValueError("flight recorder capacity must be positive")
         self.ring: deque = deque(maxlen=capacity)
         self.dump_dir = dump_dir
+        #: dump-filename discriminator (and solver-stall filter) for
+        #: recorders sharing one dump dir — the multi-tenant service
+        #: runs one recorder PER TENANT, and round-keyed-only filenames
+        #: would let two tenants dumping in the same round clobber each
+        #: other (regression-tested in tests/test_obs.py)
+        self.scope = scope
         self.min_rounds_between_dumps = min_rounds_between_dumps
         self.dumps: List[str] = []  # paths written, oldest first
         self.rounds_seen = 0
@@ -93,9 +100,19 @@ class FlightRecorder:
         """Write the ring out; returns the path. The payload is both a
         flight dump (`rounds`) and a Chrome trace (`traceEvents`)."""
         if path is None:
+            tag = f"{self.scope}_" if self.scope else ""
             path = os.path.join(
-                self.dump_dir, f"flight_{reason}_r{self.rounds_seen:06d}.json"
+                self.dump_dir, f"flight_{tag}{reason}_r{self.rounds_seen:06d}.json"
             )
+            # two recorders in one dir (or a restarted service whose
+            # round counter reset) must never clobber an existing dump:
+            # the filename is a post-mortem artifact, not a slot
+            if os.path.exists(path):
+                i = 1
+                stem, ext = os.path.splitext(path)
+                while os.path.exists(f"{stem}_{i}{ext}"):
+                    i += 1
+                path = f"{stem}_{i}{ext}"
         # the dir may not exist yet (--flight-dir ./flight on a fresh
         # checkout) or may have been removed mid-run; a failed dump must
         # not kill the service loop it exists to post-mortem
@@ -110,13 +127,23 @@ class FlightRecorder:
         # not just that it did
         from .soltel import recent_stalls
 
+        stalls = recent_stalls()
+        if self.scope:
+            # a tenant-scoped recorder's post-mortem must not carry
+            # OTHER tenants' stall attribution; untagged events (from
+            # code outside any tenant scope) stay visible to all
+            stalls = [
+                s for s in stalls
+                if s.get("tenant") in (None, self.scope)
+            ]
         payload = {
             "reason": reason,
             "captured_at": time.time(),
             "rounds_seen": self.rounds_seen,
             "rounds": rounds,
             "traceEvents": trace_events,
-            "solver_stalls": recent_stalls(),
+            "solver_stalls": stalls,
+            "scope": self.scope,
             "displayTimeUnit": "ms",
         }
         with open(path, "w") as f:

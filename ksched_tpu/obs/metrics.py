@@ -430,8 +430,167 @@ class NullRegistry:
 
 NULL_REGISTRY = NullRegistry()
 
+
+class _BoundFamily:
+    """A Family view with a constant label prefix pre-bound — what a
+    ``ScopedRegistry`` hands out. The scope labels (e.g. ``tenant``)
+    come FIRST in the parent family's labelnames; the view re-exposes
+    the caller's own labelnames exactly as requested, so instrumented
+    code is scope-oblivious: ``fam.labels(kind="noop").inc()`` works
+    identically whether ``fam`` came from a plain Registry or a
+    tenant-scoped view."""
+
+    __slots__ = ("_family", "_scope_values", "_labelnames")
+
+    def __init__(self, family: Family, scope_values: Tuple[str, ...], labelnames: Tuple[str, ...]) -> None:
+        self._family = family
+        self._scope_values = scope_values
+        self._labelnames = tuple(labelnames)
+
+    @property
+    def name(self) -> str:
+        return self._family.name
+
+    @property
+    def kind(self) -> str:
+        return self._family.kind
+
+    @property
+    def labelnames(self) -> Tuple[str, ...]:
+        return self._labelnames
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally or by name, not both")
+            try:
+                values = tuple(kv[ln] for ln in self._labelnames)
+            except KeyError as e:
+                raise ValueError(
+                    f"missing label {e} for metric {self._family.name!r}"
+                ) from e
+            if len(kv) != len(self._labelnames):
+                extra = set(kv) - set(self._labelnames)
+                raise ValueError(
+                    f"unknown labels {sorted(extra)} for metric {self._family.name!r}"
+                )
+        if len(values) != len(self._labelnames):
+            raise ValueError(
+                f"metric {self._family.name!r} takes labels {self._labelnames}, "
+                f"got {tuple(values)}"
+            )
+        return self._family.labels(*(self._scope_values + tuple(str(v) for v in values)))
+
+    # -- unlabeled proxy (scope-only child) --------------------------------
+
+    def _scope_child(self):
+        if self._labelnames:
+            raise ValueError(
+                f"metric {self._family.name!r} has labels {self._labelnames}; use .labels()"
+            )
+        return self._family.labels(*self._scope_values)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._scope_child().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._scope_child().dec(n)
+
+    def set(self, v: float) -> None:
+        self._scope_child().set(v)
+
+    def observe(self, v: float) -> None:
+        self._scope_child().observe(v)
+
+    @property
+    def value(self) -> float:
+        return self._scope_child().value
+
+    @property
+    def count(self) -> int:
+        return self._scope_child().count
+
+    @property
+    def sum(self) -> float:
+        return self._scope_child().sum
+
+
+class ScopedRegistry:
+    """A labelled child view of a parent Registry: every family
+    requested through it is created on the PARENT with the scope
+    labelnames prepended, and the returned handle pre-binds the scope
+    values. This is how the multi-tenant service gives each tenant its
+    own accounting without N private registries: one shared parent, one
+    ``tenant`` label, and scope-oblivious instrumented layers.
+
+    Unlike the old swap-in/swap-out pattern, concurrent scoped views
+    are safe by construction — they never mutate process state, and the
+    parent's families/children carry their own locks."""
+
+    def __init__(self, parent: Registry, labels: Dict[str, str]) -> None:
+        if not labels:
+            raise ValueError("ScopedRegistry needs at least one scope label")
+        for ln in labels:
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid scope label name {ln!r}")
+        self.parent = parent
+        self.scope_labels = dict(labels)
+        self._names = tuple(labels.keys())
+        self._values = tuple(str(v) for v in labels.values())
+
+    def scoped(self, **labels) -> "ScopedRegistry":
+        """Nested scope: labels accumulate (outer first)."""
+        merged = dict(self.scope_labels)
+        merged.update(labels)
+        return ScopedRegistry(self.parent, merged)
+
+    def _family(self, kind: str, name, help, labelnames, buckets=None) -> _BoundFamily:
+        overlap = set(self._names) & set(labelnames)
+        if overlap:
+            raise ValueError(
+                f"metric {name!r} labelnames {tuple(labelnames)} collide with "
+                f"scope labels {sorted(overlap)}"
+            )
+        full = self._names + tuple(labelnames)
+        fam = self.parent._get_or_create(name, help, kind, full, buckets)
+        return _BoundFamily(fam, self._values, tuple(labelnames))
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        return self._family("counter", name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        return self._family("gauge", name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(), buckets=None):
+        return self._family("histogram", name, help, labelnames, buckets)
+
+    def value(self, name: str, **labels) -> float:
+        """Read one sample within this scope (0.0 when absent)."""
+        return self.parent.value(name, **{**self.scope_labels, **labels})
+
+    def collect(self) -> List[Family]:
+        return self.parent.collect()
+
+    def snapshot(self) -> Dict[str, dict]:
+        return self.parent.snapshot()
+
+
+def _registry_scoped(self: Registry, **labels) -> ScopedRegistry:
+    """``reg.scoped(tenant="t3")`` — a labelled child view (see
+    ScopedRegistry)."""
+    return ScopedRegistry(self, labels)
+
+
+Registry.scoped = _registry_scoped  # type: ignore[attr-defined]
+
 _default_registry = Registry()
 _enabled = os.environ.get("KSCHED_OBS", "1").lower() not in ("0", "false", "off")
+#: thread-local registry overlay: scoped_registry pushes here, so two
+#: threads (e.g. two soak runs, or a test harness around a live
+#: service) can hold DIFFERENT scoped registries concurrently without
+#: clobbering each other through the process global — the multi-tenant
+#: loop's safety requirement (tests/test_obs.py concurrency test)
+_tls = threading.local()
 
 
 def set_enabled(on: bool) -> None:
@@ -447,15 +606,24 @@ def enabled() -> bool:
 
 
 def get_registry() -> Registry:
-    """The process-global registry (or the null registry when obs is
-    disabled). Layers that want exact per-run accounting (the soak,
-    tests) construct private Registry() instances instead — or swap the
-    global with `scoped_registry`."""
-    return _default_registry if _enabled else NULL_REGISTRY  # type: ignore[return-value]
+    """The active registry: the calling thread's scoped overlay if one
+    is entered, else the process global (or the null registry when obs
+    is disabled). Layers that want exact per-run accounting (the soak,
+    tests) construct private Registry() instances instead — or push one
+    with `scoped_registry`."""
+    if not _enabled:
+        return NULL_REGISTRY  # type: ignore[return-value]
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1]
+    return _default_registry
 
 
 def set_registry(reg: Registry) -> Registry:
-    """Replace the process-global registry; returns the previous one.
+    """Replace the PROCESS-GLOBAL registry; returns the previous one.
+    This is the cross-thread-visible swap (threads started afterwards
+    see it); thread-confined scoping should use `scoped_registry`,
+    which never touches process state.
 
     Instrumented layers resolve their metric handles at CONSTRUCTION
     time (never at import time), so swapping before building a service
@@ -467,19 +635,35 @@ def set_registry(reg: Registry) -> Registry:
 
 
 class scoped_registry:
-    """``with scoped_registry() as reg:`` — swap in a fresh (or given)
+    """``with scoped_registry() as reg:`` — push a fresh (or given)
     registry for the block and restore the previous one after. The
     soak's determinism double-run uses this so each run's counters
-    start from zero instead of accumulating in the global registry."""
+    start from zero instead of accumulating in the global registry.
+
+    Since the multi-tenant work this is THREAD-CONFINED and reentrant:
+    the registry is pushed onto a thread-local stack (read by
+    `get_registry`), so nested scopes compose and concurrent scopes in
+    different threads cannot clobber each other — the process-global
+    swap-in/swap-out this replaces was neither. Threads SPAWNED inside
+    the scope see the process global; pass the registry explicitly
+    (every obs component takes a ``registry=`` argument) when a worker
+    thread must publish into a scope."""
 
     def __init__(self, reg: Optional[Registry] = None) -> None:
         self.registry = reg if reg is not None else Registry()
-        self._prev: Optional[Registry] = None
 
     def __enter__(self) -> Registry:
-        self._prev = set_registry(self.registry)
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.registry)
         return self.registry
 
     def __exit__(self, *exc) -> None:
-        set_registry(self._prev)
-        self._prev = None
+        stack = getattr(_tls, "stack", None)
+        if not stack or stack[-1] is not self.registry:
+            raise RuntimeError(
+                "scoped_registry exited out of order (exit must happen on "
+                "the thread — and in the nesting order — that entered it)"
+            )
+        stack.pop()

@@ -382,6 +382,36 @@ class SolverStallError(RuntimeError):
 _lock = threading.Lock()
 _stalls: deque = deque(maxlen=32)
 _last_tel: Optional[SolveTelemetry] = None
+#: ambient stall attribution scope (thread-local): the multi-tenant
+#: loop enters `stall_scope(tenant_id)` around each tenant's dispatch/
+#: complete phases, so every stall event deposited while a tenant's
+#: lane is being driven carries that tenant — tenant-scoped flight
+#: recorders filter their dumps' solver_stalls section on it
+_scope_tls = threading.local()
+
+
+class stall_scope:
+    """``with stall_scope("t3"):`` — tag stall events deposited in the
+    block (this thread) with a tenant/scope discriminator. Reentrant;
+    the innermost scope wins."""
+
+    def __init__(self, scope: Optional[str]) -> None:
+        self.scope = scope
+
+    def __enter__(self) -> "stall_scope":
+        stack = getattr(_scope_tls, "stack", None)
+        if stack is None:
+            stack = _scope_tls.stack = []
+        stack.append(self.scope)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _scope_tls.stack.pop()
+
+
+def current_stall_scope() -> Optional[str]:
+    stack = getattr(_scope_tls, "stack", None)
+    return stack[-1] if stack else None
 
 
 def note_stall(reason: dict, tel: Optional[SolveTelemetry] = None) -> dict:
@@ -392,6 +422,9 @@ def note_stall(reason: dict, tel: Optional[SolveTelemetry] = None) -> dict:
         tel = _last_tel
     event = dict(reason)
     event.setdefault("ts", time.time())
+    scope = current_stall_scope()
+    if scope is not None and "tenant" not in event:
+        event["tenant"] = scope
     if tel is not None:
         event["telemetry_cols"] = list(SOLTEL_COLS)
         event["telemetry_tail"] = tel.tail()

@@ -50,6 +50,11 @@ class RoundRecord:
     deadline_miss: bool = False  # round blew its watchdog deadline
     machines_lost: int = 0  # heartbeat-expired machines this sweep
     tasks_failed: int = 0  # heartbeat-expired tasks this sweep
+    #: owning cell in a multi-tenant service ("" = single-tenant): the
+    #: per-tenant soak/obs_report group round records on this, and the
+    #: zero-cross-tenant-interference check relies on fault/degradation
+    #: counters landing ONLY in the chaos tenant's records
+    tenant: str = ""
 
 
 class RoundTracer:

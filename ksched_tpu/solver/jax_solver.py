@@ -414,6 +414,102 @@ def _solve_mcmf(
     return flow, p, steps, converged, p_overflow
 
 
+# ---------------------------------------------------------------------------
+# Stacked-CSR batched entry: one compiled program for a whole shape
+# bucket of tenant lanes (ksched_tpu/tenancy — multi-tenant service)
+# ---------------------------------------------------------------------------
+
+#: lane counts pad to pow2 buckets (repeating a real lane, which is
+#: idempotent: a duplicate lane computes the same solve and its outputs
+#: are ignored), so tenants joining/leaving re-use executables instead
+#: of recompiling per lane-count — same policy as the record buckets in
+#: graph/device_export.pad_record_count
+MIN_LANE_BUCKET = 1
+
+
+def pad_lane_count(k: int) -> int:
+    from ..utils import next_pow2
+
+    return max(next_pow2(max(k, 1)), MIN_LANE_BUCKET)
+
+
+_STACKED_SOLVES: dict = {}
+
+
+def stacked_solve_fn(
+    *,
+    alpha: int = 8,
+    max_supersteps: int = 4096,
+    tighten_sweeps: int = 32,
+    telemetry_cap: int = 0,
+    use_warm_p: bool = False,
+):
+    """The batched (block-diagonal stacked-CSR) solve program: same-
+    bucket tenant lanes solved through ONE compiled executable.
+
+    Independent flow components in a block-diagonal stack never
+    interact, so batching them is semantically free; the lane axis is
+    the leading dimension of every argument (the flat offset-id stack
+    reshaped [L, ...] — lane i's node ids are its local ids plus
+    i*n_cap in the flat view, see tenancy/batch.py). The program is
+    ``jit(vmap(_solve_mcmf))`` with the statics bound, which gives the
+    two properties the multi-tenant acceptance demands by
+    construction:
+
+    - **per-lane convergence masks**: jax's while-loop batching runs
+      the loop until every lane's own condition is false and freezes
+      finished lanes via select — a slow tenant cannot change another
+      lane's state, and each lane's superstep count (carried in its
+      own lane of the loop state) stops the moment IT converges;
+    - **bit-identical per-lane solves**: each lane's carry evolves
+      through exactly the ops the single-lane `_solve_mcmf` applies to
+      the same int32 data, so flows, potentials, superstep counts, and
+      telemetry rows equal the lane solved alone (asserted exhaustively
+      by tests/test_tenancy.py).
+
+    A lane that exhausts ``max_supersteps`` freezes unconverged
+    (its ``converged`` output stays False) without extending the other
+    lanes' superstep counts; wall-clock for the whole program is
+    bounded by the slowest lane's budget, which is why the tenancy
+    layer batches only budget-capped attempts and escalates per lane
+    (tenancy/batch.py). Returns per-lane tuples shaped
+    ``(flow [L, m], p [L, n], steps [L], converged [L],
+    p_overflow [L][, telemetry [L, cap, W]])``.
+
+    Cached per statics tuple: with pow2 lane-count and shape buckets
+    the warm service re-uses one executable per (bucket, policy), the
+    compile-cache amortization the ROADMAP's multi-tenant story names.
+    The jaxpr contracts pin this program scatter-free, 32-bit, and
+    hash-stable across raw sizes in a bucket and lane counts in a lane
+    bucket (tests/test_static_analysis.py)."""
+    key = (alpha, max_supersteps, tighten_sweeps, telemetry_cap, use_warm_p)
+    fn = _STACKED_SOLVES.get(key)
+    if fn is None:
+        statics = dict(
+            alpha=alpha,
+            max_supersteps=max_supersteps,
+            tighten_sweeps=tighten_sweeps,
+            telemetry_cap=telemetry_cap,
+            slot_stable=False,
+        )
+        if use_warm_p:
+
+            def lane(cap, cost, supply, flow0, eps, warm_p, *plan):
+                return _solve_mcmf(
+                    cap, cost, supply, flow0, eps, *plan,
+                    warm_p=warm_p, use_warm_p=True, **statics,
+                )
+
+        else:
+
+            def lane(cap, cost, supply, flow0, eps, *plan):
+                return _solve_mcmf(cap, cost, supply, flow0, eps, *plan, **statics)
+
+        fn = jax.jit(jax.vmap(lane))
+        _STACKED_SOLVES[key] = fn
+    return fn
+
+
 class JaxSolver(FlowSolver):
     """Cost-scaling push-relabel on device, warm-started across rounds.
 

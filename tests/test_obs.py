@@ -312,6 +312,101 @@ def test_scoped_registry_swaps_and_restores():
     assert reg.value("scoped_total") == 1
 
 
+def test_scoped_registry_nests():
+    with scoped_registry() as outer:
+        outer.counter("outer_total", "x").inc()
+        with scoped_registry() as inner:
+            assert obs_metrics.get_registry() is inner
+            inner.counter("inner_total", "x").inc()
+        assert obs_metrics.get_registry() is outer
+    assert outer.value("outer_total") == 1 and outer.value("inner_total") == 0
+
+
+def test_scoped_registry_is_thread_confined():
+    """The multi-tenant safety property: concurrent scopes in different
+    threads must not clobber each other (the old process-global swap
+    did), and a scope never leaks into an unscoped thread."""
+    base = obs_metrics.get_registry()
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def run(i):
+        try:
+            with scoped_registry() as reg:
+                barrier.wait(timeout=5)  # every thread inside a scope at once
+                assert obs_metrics.get_registry() is reg
+                reg.counter("private_total", "x").inc(i + 1)
+                barrier.wait(timeout=5)
+                assert obs_metrics.get_registry() is reg
+                assert reg.value("private_total") == i + 1  # no cross-talk
+            assert obs_metrics.get_registry() is base
+        except Exception as e:  # noqa: BLE001 — surfaced via the errors list
+            errors.append((i, e))
+            try:
+                barrier.abort()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    assert obs_metrics.get_registry() is base
+
+
+def test_scoped_registry_out_of_order_exit_is_an_error():
+    a = scoped_registry()
+    b = scoped_registry()
+    a.__enter__()
+    b.__enter__()
+    with pytest.raises(RuntimeError):
+        a.__exit__(None, None, None)
+    b.__exit__(None, None, None)
+    a.__exit__(None, None, None)
+
+
+def test_registry_scoped_label_views():
+    """Registry.scoped(tenant=...) views share ONE parent family with
+    the scope label prepended; per-view samples never alias."""
+    reg = Registry()
+    a = reg.scoped(tenant="a")
+    b = reg.scoped(tenant="b")
+    a.counter("ksched_rt_total", "x").inc(2)
+    b.counter("ksched_rt_total", "x").inc(5)
+    assert reg.value("ksched_rt_total", tenant="a") == 2
+    assert reg.value("ksched_rt_total", tenant="b") == 5
+    assert a.value("ksched_rt_total") == 2
+    # labelled families compose: scope labels come first
+    fam = a.counter("ksched_rt_kinds_total", "x", labelnames=("kind",))
+    fam.labels(kind="noop").inc()
+    assert reg.value("ksched_rt_kinds_total", tenant="a", kind="noop") == 1
+    assert reg.value("ksched_rt_kinds_total", tenant="b", kind="noop") == 0
+    # histograms keep their buckets through the view
+    h = b.histogram("ksched_rt_ms", "x", buckets=(1, 2, 4))
+    h.observe(3)
+    assert b.value("ksched_rt_ms") == 1
+    # the text exposition carries the tenant label
+    text = render_prometheus(reg)
+    assert 'ksched_rt_total{tenant="a"} 2' in text
+    # nested scoping accumulates labels
+    ab = a.scoped(shard="0")
+    ab.counter("ksched_rt_nested_total", "x").inc()
+    assert reg.value("ksched_rt_nested_total", tenant="a", shard="0") == 1
+
+
+def test_registry_scoped_label_collision_is_an_error():
+    reg = Registry()
+    view = reg.scoped(tenant="a")
+    with pytest.raises(ValueError):
+        view.counter("ksched_collide_total", "x", labelnames=("tenant",))
+    # and a scope-labelled name cannot silently alias an unscoped one
+    reg.counter("ksched_plain_total", "x")
+    with pytest.raises(ValueError):
+        view.counter("ksched_plain_total", "x")
+
+
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
@@ -364,6 +459,57 @@ def test_flight_dump_creates_missing_dir(tmp_path):
                         registry=Registry(), min_rounds_between_dumps=1)
     path = fl.note_round(_rec(0, deadline_miss=True))
     assert path is not None and json.loads(open(path).read())["reason"] == "deadline_miss"
+
+
+def test_flight_scope_discriminates_same_round_dumps(tmp_path):
+    """REGRESSION (multi-tenant satellite): auto-dump filenames were
+    round-keyed only, so two tenants dumping in the same round
+    clobbered each other. Scoped recorders must write distinct files,
+    and even an unscoped name collision falls back to a suffix instead
+    of overwriting."""
+    reg = Registry()
+    a = FlightRecorder(capacity=2, dump_dir=str(tmp_path), registry=reg,
+                       min_rounds_between_dumps=1, scope="tenant_a")
+    b = FlightRecorder(capacity=2, dump_dir=str(tmp_path), registry=reg,
+                       min_rounds_between_dumps=1, scope="tenant_b")
+    pa = a.note_round(_rec(0, noop_round=True))
+    pb = b.note_round(_rec(0, noop_round=True))
+    assert pa != pb and pa is not None and pb is not None
+    assert "tenant_a" in pa and "tenant_b" in pb
+    assert json.loads(open(pa).read())["scope"] == "tenant_a"
+    # unscoped recorders at the same round index no longer clobber
+    u1 = FlightRecorder(capacity=2, dump_dir=str(tmp_path), registry=reg,
+                        min_rounds_between_dumps=1)
+    u2 = FlightRecorder(capacity=2, dump_dir=str(tmp_path), registry=reg,
+                        min_rounds_between_dumps=1)
+    p1 = u1.note_round(_rec(0, noop_round=True))
+    p2 = u2.note_round(_rec(1, noop_round=True))
+    assert p1 != p2
+    assert json.loads(open(p1).read())["rounds"][0]["record"]["round_index"] == 0
+    assert json.loads(open(p2).read())["rounds"][0]["record"]["round_index"] == 1
+
+
+def test_flight_scope_filters_stall_attribution(tmp_path):
+    """Tenant-scoped dumps carry only their own (or untagged) soltel
+    stall events; stall_scope tags events with the ambient tenant."""
+    from ksched_tpu.obs import soltel
+
+    soltel.reset_stalls()
+    with scoped_registry():
+        with soltel.stall_scope("tenant_a"):
+            soltel.note_stall({"kind": "excess_plateau"})
+        with soltel.stall_scope("tenant_b"):
+            soltel.note_stall({"kind": "eps_plateau"})
+        soltel.note_stall({"kind": "backend_error"})  # untagged
+        fl = FlightRecorder(capacity=2, dump_dir=str(tmp_path),
+                            registry=Registry(), scope="tenant_a",
+                            min_rounds_between_dumps=1)
+        path = fl.note_round(_rec(0, noop_round=True))
+    stalls = json.loads(open(path).read())["solver_stalls"]
+    kinds = {s["kind"] for s in stalls}
+    assert kinds == {"excess_plateau", "backend_error"}
+    assert {s.get("tenant") for s in stalls} == {"tenant_a", None}
+    soltel.reset_stalls()
 
 
 def test_flight_crash_hook_chains(tmp_path):

@@ -547,6 +547,55 @@ def test_refit_slot_stable_combo_is_scatter_free():
 
 
 # ---------------------------------------------------------------------------
+# Multi-tenant stacked-CSR batched solve (tenancy/batch.py, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_stacked_no_64bit_no_scatter():
+    """The batched lane program stays a SOLVE program: vmap's while-
+    loop batching freezes converged lanes with selects, never
+    scatters, and everything is int32 — per-lane convergence masks
+    cost zero scatter traffic."""
+    for warm in (False, True):
+        closed = jc.trace_stacked(4, 20, 100, use_warm_p=warm)
+        report = jc.check_jaxpr("stacked", closed)
+        assert report.ok_scatter, (warm, report.scatter_eqns)
+        assert report.ok_64bit, (warm, report.violations_64bit)
+        assert report.num_eqns > 0
+
+
+def test_stacked_telemetry_variant_no_scatter():
+    report = jc.check_jaxpr(
+        "stacked", jc.trace_stacked(4, 20, 100, telemetry_cap=512)
+    )
+    assert report.ok_scatter and report.ok_64bit
+
+
+def test_stacked_lane_count_and_bucket_hash_stable():
+    """The executable-reuse contract behind the warm multi-tenant
+    process: raw sizes within a pow2 shape bucket AND raw lane counts
+    within a pow2 lane bucket trace byte-identical programs (tenant
+    churn must not recompile); cross-bucket/cross-lane-count hashes
+    differ (the check isn't vacuous)."""
+    base = jc.jaxpr_hash(jc.trace_stacked(3, 20, 100))
+    assert base == jc.jaxpr_hash(jc.trace_stacked(4, 24, 110))  # same buckets
+    assert base != jc.jaxpr_hash(jc.trace_stacked(8, 20, 100))  # lane bucket
+    assert base != jc.jaxpr_hash(jc.trace_stacked(4, 20, 300))  # shape bucket
+    from ksched_tpu.solver.jax_solver import pad_lane_count
+
+    assert pad_lane_count(3) == pad_lane_count(4) == 4
+
+
+def test_stacked_warm_variant_is_distinct():
+    """use_warm_p batches the dirty-frontier refit across lanes — a
+    DIFFERENT traced program (the warm seed is a real invar), so the
+    fresh pin above isn't accidentally covering it."""
+    assert jc.jaxpr_hash(jc.trace_stacked(4, 20, 100)) != jc.jaxpr_hash(
+        jc.trace_stacked(4, 20, 100, use_warm_p=True)
+    )
+
+
+# ---------------------------------------------------------------------------
 # Level 2: negative tests — each contract detects a seeded violation
 # ---------------------------------------------------------------------------
 

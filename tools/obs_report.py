@@ -74,6 +74,35 @@ def report_records(records: list) -> None:
     print(_header())
     for phase in phases:
         print(_row(phase, [r["phases_ms"].get(phase, 0.0) for r in active]))
+    _report_tenants(active)
+
+
+def _report_tenants(active: list) -> None:
+    """Per-tenant percentile view: when the records carry a multi-
+    tenant service's ``tenant`` field, break the total-phase
+    percentiles (plus fault/NOOP attribution) out per cell — the
+    operator's one-glance check that a pathological tenant degraded
+    only its own lane."""
+    tenants = sorted({r.get("tenant") or "" for r in active})
+    if tenants == [""]:
+        return
+    print("\nper-tenant (total phase):")
+    print(_header())
+    for tid in tenants:
+        rows = [r for r in active if (r.get("tenant") or "") == tid]
+        label = tid or "<untagged>"
+        suffix = []
+        noops = sum(1 for r in rows if r.get("noop_round"))
+        faults = sum(
+            sum((r.get("faults_injected") or {}).values()) for r in rows
+        )
+        degr = sum(r.get("degradations", 0) for r in rows)
+        if faults or degr or noops:
+            suffix.append(f"  [faults={faults} degr={degr} noop={noops}]")
+        print(
+            _row(label, [r["phases_ms"].get("total", 0.0) for r in rows])
+            + "".join(suffix)
+        )
 
 
 def _hist_percentile(buckets: list, count: int, pct: float) -> float:

@@ -424,6 +424,191 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# multi-tenant soak: N cells, one warm batched solver, chaos on one
+# ---------------------------------------------------------------------------
+
+
+def _drive_tenant_fleet(args, tenant_ids, chaos_on, log=print):
+    """Run one multi-tenant process serving ``tenant_ids`` (mixed cell
+    sizes cycling 3 classes) for args.rounds logical rounds; chaos is
+    injected ONLY into ``chaos_on``'s cell. Returns per-tenant round
+    records, placements, and latency summaries."""
+    import numpy as np
+
+    from ksched_tpu.cluster import PodEvent
+    from ksched_tpu.obs.metrics import Registry
+    from ksched_tpu.runtime.chaos import ChaosPolicy, FaultInjector
+    from ksched_tpu.tenancy import MultiTenantService
+
+    #: three cell size classes -> mixed pow2 shape buckets
+    SIZES = ((3, 2, 4), (5, 2, 4), (9, 2, 8))  # (machines, pus/core, slots)
+    reg = Registry()
+    mts = MultiTenantService(
+        registry=reg, pipeline=True, flight_dir=getattr(args, "flight_dir", None)
+    )
+    cells = {}
+    for tid in tenant_ids:
+        i = int(tid.split("_")[-1])
+        machines, ppc, slots = SIZES[i % len(SIZES)]
+        inj = None
+        if tid == chaos_on:
+            inj = FaultInjector(
+                ChaosPolicy(
+                    seed=args.seed + 17,
+                    solver_fault_prob=0.25,
+                    solver_total_outage_prob=0.1,
+                )
+            )
+        cells[tid] = mts.add_tenant(
+            tid,
+            machines=machines,
+            pus_per_core=ppc,
+            slots=slots,
+            seed=args.seed * 1000 + i,
+            injector=inj,
+            machine_timeout_s=1e9,  # logical-time soak: no expiry
+        )
+    # per-tenant seeded workloads: arrivals + completions, reproducible
+    # in isolation (the parity re-runs drive the same streams)
+    wrngs = {
+        tid: np.random.default_rng([args.seed, int(tid.split("_")[-1])])
+        for tid in tenant_ids
+    }
+    pod_seq = {tid: 0 for tid in tenant_ids}
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        for r in range(args.rounds):
+            for tid, cell in cells.items():
+                rng = wrngs[tid]
+                if len(cell.svc.pod_to_task) < 64:
+                    for _ in range(int(rng.integers(0, 3))):
+                        cell.api.submit_pod(
+                            PodEvent(pod_id=f"{tid}_pod_{pod_seq[tid]}")
+                        )
+                        pod_seq[tid] += 1
+                if r % 2 == 1:
+                    bound = sorted(
+                        p for p, t in cell.svc.pod_to_task.items()
+                        if t in cell.svc.scheduler.task_bindings
+                    )
+                    if bound:
+                        k = int(rng.integers(1, min(3, len(bound)) + 1))
+                        for j in sorted(
+                            int(x) for x in rng.choice(len(bound), k, replace=False)
+                        ):
+                            cell.svc.complete_pod(bound[j])
+            mts.run_round(now=float(r))
+        mts.drain()
+    out = {}
+    for tid, cell in cells.items():
+        recs = cell.svc.tracer.records
+        out[tid] = dict(
+            bindings=dict(cell.api.bindings()),
+            work=[rec.solver_work for rec in recs],
+            scheduled=[rec.num_scheduled for rec in recs],
+            faults=sum(sum(r2.faults_injected.values()) for r2 in recs),
+            degradations=sum(r2.degradations for r2 in recs),
+            noops=sum(1 for r2 in recs if r2.noop_round),
+            summary=cell.svc.tracer.summary(),
+            tenants_seen={r2.tenant for r2 in recs},
+        )
+    meta = dict(
+        flushes=mts.batcher.flushes,
+        last_groups=mts.batcher.last_groups,
+        last_lanes=mts.batcher.last_lanes,
+        quarantines=reg.value("ksched_tenant_quarantines_total"),
+    )
+    mts.close()
+    return out, meta
+
+
+def run_tenant_soak(args, log=print) -> int:
+    """--tenants N: the multi-tenant acceptance soak. One warm process
+    serves N synthetic cells (mixed sizes across 3 cell classes) with
+    chaos injected into ONE tenant, then asserts:
+
+    - zero cross-tenant interference in the round trace: every clean
+      tenant's records carry 0 faults / 0 degradations / 0 NOOPs, and
+      each record is tagged with its own tenant only;
+    - per-tenant placements (and per-round solver work) bit-identical
+      to the same tenant run in ISOLATION — its own single-cell
+      process with the same seed — for every clean tenant;
+    - per-tenant p50/p99 round latency published.
+    """
+    import time as _time
+
+    n = args.tenants
+    tenant_ids = [f"cell_{i}" for i in range(n)]
+    chaos_on = (
+        tenant_ids[args.chaos_tenant]
+        if 0 <= args.chaos_tenant < n
+        else None
+    )
+    t0 = _time.perf_counter()
+    multi, meta = _drive_tenant_fleet(args, tenant_ids, chaos_on, log)
+    log(
+        f"fleet: {n} cells x {args.rounds} rounds in "
+        f"{_time.perf_counter() - t0:.1f}s — {meta['flushes']} batch "
+        f"flushes, last round {meta['last_groups']} stacked program(s) "
+        f"for {meta['last_lanes']} lanes, "
+        f"quarantines={meta['quarantines']:.0f}"
+    )
+    # -- per-tenant latency + interference report -----------------------
+    log(f"{'tenant':<10} {'rounds':>6} {'p50_ms':>9} {'p99_ms':>9} "
+        f"{'bound':>6} {'faults':>6} {'degr':>5} {'noop':>5}")
+    for tid in tenant_ids:
+        m = multi[tid]
+        s = m["summary"]
+        log(
+            f"{tid:<10} {s.get('rounds', 0):>6} "
+            f"{s.get('p50_ms', 0.0):>9.2f} {s.get('p99_ms', 0.0):>9.2f} "
+            f"{len(m['bindings']):>6} {m['faults']:>6} "
+            f"{m['degradations']:>5} {m['noops']:>5}"
+        )
+    # -- zero cross-tenant interference ---------------------------------
+    for tid in tenant_ids:
+        m = multi[tid]
+        assert m["tenants_seen"] <= {tid}, (
+            f"{tid} round records carry foreign tenant tags: {m['tenants_seen']}"
+        )
+        if tid == chaos_on:
+            continue
+        assert m["faults"] == 0 and m["degradations"] == 0 and m["noops"] == 0, (
+            f"cross-tenant interference: clean tenant {tid} shows "
+            f"faults={m['faults']} degradations={m['degradations']} "
+            f"noops={m['noops']}"
+        )
+    if chaos_on is not None:
+        cm = multi[chaos_on]
+        assert cm["faults"] > 0, (
+            "chaos tenant drew no faults — raise --rounds or the fault probs"
+        )
+        log(
+            f"chaos contained to {chaos_on}: faults={cm['faults']} "
+            f"degradations={cm['degradations']} noops={cm['noops']}"
+        )
+    # -- isolation parity: each clean tenant vs its own solo process ----
+    checked = 0
+    for tid in tenant_ids:
+        if tid == chaos_on:
+            continue
+        solo, _ = _drive_tenant_fleet(args, [tid], None, log)
+        for key in ("bindings", "work", "scheduled"):
+            assert solo[tid][key] == multi[tid][key], (
+                f"isolation parity broken for {tid}: {key} differs "
+                f"between the {n}-cell process and the solo run"
+            )
+        checked += 1
+    log(
+        f"TENANT SOAK OK: {checked} clean tenants bit-identical to their "
+        f"isolated runs; zero cross-tenant interference in the round trace"
+    )
+    return 0
+
+
 def chaos_main(args) -> int:
     import copy
 
@@ -499,6 +684,16 @@ def main() -> int:
     ap.add_argument("--chaos", action="store_true",
                     help="event-path SchedulerService soak under a seeded "
                     "fault schedule (see module docstring)")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="multi-tenant soak: serve N synthetic cells (mixed "
+                    "sizes) from ONE warm batched-solver process, chaos on "
+                    "--chaos-tenant only; asserts per-tenant placements "
+                    "bit-identical to each tenant run in isolation and zero "
+                    "cross-tenant interference in the round trace "
+                    "(make tenant-smoke)")
+    ap.add_argument("--chaos-tenant", type=int, default=0, metavar="I",
+                    help="tenant index the multi-tenant soak injects chaos "
+                    "into (-1 = no chaos)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--slots", type=int, default=16,
                     help="chaos mode: task slots per PU")
@@ -550,6 +745,10 @@ def main() -> int:
     args = ap.parse_args()
     if args.machines is None:  # per-mode default (device soak vs chaos)
         args.machines = 10 if args.chaos else 500
+
+    if args.tenants:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_tenant_soak(args)
 
     if args.chaos:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
