@@ -23,6 +23,12 @@
 #                     to each tenant run in isolation, zero cross-tenant
 #                     interference in the round trace, and reports
 #                     per-tenant p50/p99 (docs/multitenancy.md)
+#   make recovery-smoke  state-integrity soak: seeded device-buffer
+#                     corruption + two mid-soak kill-and-restores vs a
+#                     clean control run — asserts 100% corruption
+#                     detection (fingerprint audits), zero false
+#                     positives, warm delta-sized restores, and
+#                     bit-identical placements (docs/robustness.md)
 #   make bench-gate   check BENCH_TRAJECTORY.jsonl: fail if any config's
 #                     newest p50 regressed >15% vs its previous entry,
 #                     or its supersteps_p50 regressed >25% (+8 slack)
@@ -38,7 +44,7 @@ SHELL := /bin/bash
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke bench-gate verify baseline
+.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke recovery-smoke bench-gate verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
@@ -65,6 +71,11 @@ tenant-smoke:
 	timeout -k 10 570 env JAX_PLATFORMS=cpu $(PY) tools/soak.py \
 	  --tenants 16 --rounds 40 --seed 0 --chaos-tenant 0
 
+recovery-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/soak.py --chaos \
+	  --rounds 512 --chunk 128 --seed 11 --machines 6 --slots 8 \
+	  --chaos-restore-every 128 --verify-recovery
+
 bench-gate:
 	$(PY) tools/bench_compare.py gate BENCH_TRAJECTORY.jsonl
 
@@ -77,7 +88,7 @@ test:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-verify: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke
+verify: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke recovery-smoke
 
 baseline:
 	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

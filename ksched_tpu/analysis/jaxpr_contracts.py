@@ -579,6 +579,36 @@ def trace_delta_apply(ka_raw: int, kn_raw: int, n_raw: int = 20, m_raw: int = 10
     )
 
 
+def trace_state_fingerprint(n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the device-state fingerprint program
+    (runtime/integrity.state_fingerprint_fn): per-buffer weighted
+    checksums of the five persistent problem buffers. Must stay
+    scatter-free and 32-bit — the integrity audit rides the normal
+    solve cadence and gets no scatter exemption."""
+    from ..runtime.integrity import state_fingerprint_fn
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    return jax.make_jaxpr(state_fingerprint_fn())(
+        _sds((n,)), _sds((m,)), _sds((m,)), _sds((m,)), _sds((m,)),
+    )
+
+
+def trace_plan_fingerprint(n_raw: int = 20, m_raw: int = 100, e_raw: int = 256):
+    """Abstract trace of the slot-plan fingerprint program
+    (runtime/integrity.plan_fingerprint_fn) over the ten maintained
+    plan tensors."""
+    from ..runtime.integrity import plan_fingerprint_fn
+    from ..utils import next_pow2
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    e = max(next_pow2(e_raw), 2 * m)
+    return jax.make_jaxpr(plan_fingerprint_fn())(
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((2 * m,)),
+        _sds((e,)), _sds((e,), jnp.bool_), _sds((n,)), _sds((n,)),
+        _sds((n,), jnp.bool_),
+    )
+
+
 def trace_warm_flow(n_raw: int = 20, m_raw: int = 100):
     """Abstract trace of the device warm-flow carry
     (graph/device_export.device_warm_flow_fn) — elementwise only, so

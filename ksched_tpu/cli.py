@@ -51,8 +51,10 @@ from .utils import (
 )
 
 #: service-checkpoint sidecar version (the scheduler state itself rides
-#: in runtime/checkpoint.py's save_scheduler format)
-SERVICE_CHECKPOINT_VERSION = 1
+#: in runtime/checkpoint.py's save_scheduler format). v2 adds the warm
+#: restore companion (path + ".wal": journal WAL + device-state
+#: manifest) and the round/ladder counters; v1 sidecars still load.
+SERVICE_CHECKPOINT_VERSION = 2
 
 
 class SchedulerService:
@@ -80,6 +82,7 @@ class SchedulerService:
         pipeline: bool = False,
         device_resident: bool = False,
         tenant: str = "",
+        audit_every: int = 0,
         _restored: Optional[Tuple] = None,
     ) -> None:
         self.api = api
@@ -147,6 +150,23 @@ class SchedulerService:
         self.ladder: Optional[DegradingSolver] = (
             ladder if isinstance(ladder, DegradingSolver) else None
         )
+        #: device-state integrity audit cadence (0 = off): every Nth
+        #: export, the placement solver fingerprints the device mirror
+        #: against the host journal truth and repairs divergence
+        #: through the escalating ladder (runtime/integrity.py)
+        self.audit_every = audit_every
+        self.scheduler.solver.audit_every = audit_every
+        if audit_every and not device_resident:
+            warnings.warn(
+                "audit_every is set but device_resident is off: the "
+                "integrity audit covers the persistent device mirror, "
+                "so ZERO audits will run",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        #: True when this service came from restore() via the warm
+        #: manifest path (False: fresh start or cold replay fallback)
+        self.restored_warm = False
         self.max_tasks_per_pu = max_tasks_per_pu
         # Bidirectional id maps (reference :44-62).
         self.pod_to_task: Dict[str, int] = {}
@@ -604,6 +624,16 @@ class SchedulerService:
         self._g_pods.set(len(self.pod_to_task))
         self._g_bound.set(len(self.scheduler.task_bindings))
         self._g_machines.set(len(self.node_to_machine))
+        # a state divergence this round already deposited its
+        # structured soltel event; make sure a flight dump carries it
+        # (rate-limited by the recorder, like the other triggers). The
+        # flag is CONSUMED here — idle sweeps never run the gate, so a
+        # stale flag would re-trigger dumps for a long-repaired event.
+        sol = self.scheduler.solver
+        if getattr(sol, "last_divergence", None):
+            if self.flight is not None:
+                self.flight.trigger("state_divergence")
+            sol.last_divergence = None
         rec = None
         if self.tracer is not None:
             faults = {}
@@ -690,23 +720,79 @@ class SchedulerService:
         runtime/checkpoint.py, written to ``path + ".sched"``) plus the
         service-owned id maps and round bookkeeping as a sidecar at
         ``path`` — everything a restarted process needs to keep serving
-        the same pods against the same nodes."""
-        from .runtime.checkpoint import save_scheduler
+        the same pods against the same nodes. Additionally writes the
+        WARM manifest at ``path + ".wal"`` (journal WAL + device-state
+        manifest + solver warm endpoints + ladder counters) so
+        restore() can resume on the delta-sized warm path instead of
+        the cold full_build; a damaged/missing manifest degrades
+        restore to the cold event replay, never blocks it."""
+        import os
+
+        from .runtime.checkpoint import (
+            atomic_pickle,
+            save_scheduler,
+            save_warm_manifest,
+        )
 
         # bindings queued for the next pipelined dispatch window would
         # not survive the restart; post them before snapshotting
         self.flush_pending_bindings()
         save_scheduler(self.scheduler, path + ".sched")
+        # per-CHECKPOINT nonce binding sidecar <-> warm manifest: the
+        # job_id is a service-lifetime constant, so it cannot tell a
+        # stale .wal (from an earlier save to the same path) apart
+        # from this save's. Drawn OUTSIDE the seeded id stream — a
+        # seeded draw here would shift every later task uid and break
+        # kills-vs-control placement parity in the recovery soak.
+        nonce = int.from_bytes(os.urandom(8), "little")
         state = {
             "version": SERVICE_CHECKPOINT_VERSION,
+            "ckpt_nonce": nonce,
             "pod_to_task": dict(self.pod_to_task),
             "node_to_machine": dict(self.node_to_machine),
             "job_id": self.job_id,
             "old_bindings": dict(self.old_bindings),
             "max_tasks_per_pu": self.max_tasks_per_pu,
+            # round/ladder continuity (the restart-budget/quarantine
+            # counters of the manifest; per-tenant via `tenant`)
+            "tenant": self.tenant,
+            "noop_rounds": self.noop_rounds,
+            "degradations_total": (
+                self.ladder.degradations_total if self.ladder is not None else 0
+            ),
+            "backlog_dirty": self.backlog_dirty,
+            "audit_every": self.audit_every,
         }
-        with open(path, "wb") as f:
-            pickle.dump(state, f)
+        atomic_pickle(state, path)
+        try:
+            save_warm_manifest(
+                self.scheduler,
+                path + ".wal",
+                # the nonce binds the manifest to THIS sidecar: restore
+                # refuses a stale .wal left by an earlier checkpoint
+                # at the same path (job_id rides along for operators)
+                meta={
+                    "tenant": self.tenant,
+                    "job_id": int(self.job_id),
+                    "ckpt_nonce": nonce,
+                },
+            )
+        except Exception as e:  # noqa: BLE001 — warm restore is an
+            # optimization; an unpicklable cost model (or any manifest
+            # writer defect) must not take checkpointing down with it.
+            # A PREVIOUS checkpoint's manifest at this path must not
+            # survive either: restore would pair the old scheduler
+            # state with the new sidecar's id maps.
+            try:
+                os.remove(path + ".wal")
+            except OSError:
+                pass
+            warnings.warn(
+                f"warm manifest not written ({e}); restore will use the "
+                "cold event replay",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     @classmethod
     def restore(
@@ -724,30 +810,123 @@ class SchedulerService:
         span_tracer: Optional[SpanTracer] = None,
         pipeline: bool = False,
         device_resident: bool = False,
+        audit_every: Optional[int] = None,
     ) -> "SchedulerService":
-        """Rebuild a service from save_checkpoint output: the scheduler
-        is replayed through the event API, then the id maps are
-        re-attached. Heartbeat history does not survive the restart —
-        machines are unmonitored until their next beat (the same
-        cold-rebuild property the reference has)."""
-        from .runtime.checkpoint import restore_scheduler
+        """Rebuild a service from save_checkpoint output. With an
+        intact warm manifest (``path + ".wal"``) the scheduler resumes
+        WARM: the device-state manifest is replayed into a rebuilt
+        DeviceGraphState/SlotPlanState, the device mirror is primed
+        outside any round, and the solver's carried flow/potentials/
+        endpoint masks are re-imported — the first post-restore round
+        is already delta-sized and its solve warm, bit-identical to
+        the never-killed process. A missing or corrupted manifest
+        (torn write, dropped/duplicated WAL record, version mismatch)
+        is DETECTED and contained: restore warns and falls back to the
+        cold event replay. Heartbeat history never survives the
+        restart — machines are unmonitored until their next beat (the
+        same cold-rebuild property the reference has).
 
-        with open(path, "rb") as f:
-            state = pickle.load(f)
-        if state["version"] != SERVICE_CHECKPOINT_VERSION:
-            raise ValueError(f"unsupported service checkpoint version {state['version']}")
+        Damaged inputs raise distinct, actionable errors: a missing or
+        garbage sidecar -> CheckpointDamaged, a missing ``.sched``
+        companion -> CheckpointMissing, a version mismatch ->
+        CheckpointVersionError."""
+        import os
+
+        from .runtime.checkpoint import (
+            CheckpointDamaged,
+            CheckpointMissing,
+            CheckpointVersionError,
+            load_warm_manifest,
+            restore_scheduler,
+        )
+
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+        except FileNotFoundError:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified: damaged bytes
+            raise CheckpointDamaged(
+                f"service checkpoint sidecar {path} is truncated or not a "
+                f"ksched checkpoint ({type(e).__name__}: {e}); restore from "
+                "an intact checkpoint or start cold"
+            ) from e
+        if not isinstance(state, dict) or "version" not in state:
+            raise CheckpointDamaged(
+                f"service checkpoint sidecar {path} holds no version field "
+                "— not a ksched service checkpoint"
+            )
+        if state["version"] not in (1, SERVICE_CHECKPOINT_VERSION):
+            raise CheckpointVersionError(
+                f"unsupported service checkpoint version {state['version']} "
+                f"(this build reads 1..{SERVICE_CHECKPOINT_VERSION}); "
+                "re-checkpoint from a matching build"
+            )
+        if not os.path.exists(path + ".sched"):
+            raise CheckpointMissing(
+                f"service checkpoint {path} is missing its scheduler "
+                f"companion {path + '.sched'} — the sidecar alone cannot "
+                "rebuild the world state; restore both files together"
+            )
         if degrade:
             backend = build_degradation_ladder(
                 backend if backend is not None else ReferenceSolver(),
                 backend_name,
                 injector=injector,
             )
-        parts = restore_scheduler(
-            path + ".sched",
-            cost_model_factory=MODEL_REGISTRY[cost_model],
-            backend=backend,
-            device_resident=device_resident,
-        )
+        parts = None
+        restored_warm = False
+        wal_fallback = None  # fallback kind when the manifest was rejected
+        wal_path = path + ".wal"
+        if os.path.exists(wal_path):
+            try:
+                parts, meta = load_warm_manifest(
+                    wal_path, backend=backend, device_resident=device_resident
+                )
+                if meta.get("ckpt_nonce") != state.get("ckpt_nonce"):
+                    raise CheckpointDamaged(
+                        f"warm manifest {wal_path} belongs to a different "
+                        f"checkpoint (nonce {meta.get('ckpt_nonce')} != "
+                        f"sidecar {state.get('ckpt_nonce')}) — a stale "
+                        ".wal from an earlier save at this path"
+                    )
+                restored_warm = True
+            except Exception as e:  # noqa: BLE001 — contained: any
+                # manifest damage or rejection degrades to the cold
+                # replay; CORRUPTION (torn/dropped/duplicated/bit-rot
+                # records) is labelled apart from other rejections
+                # (version drift, stale nonce, unpicklable payload) so
+                # an operator fleet-upgrading builds doesn't read the
+                # restore counter as bit rot
+                from .runtime.integrity import WALCorrupted
+
+                parts = None
+                wal_fallback = (
+                    "wal_corrupt_fallback"
+                    if isinstance(e, WALCorrupted)
+                    else "wal_rejected_fallback"
+                )
+                warnings.warn(
+                    f"warm manifest {wal_path} rejected ({e}); falling "
+                    "back to cold event replay",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        if parts is None:
+            parts = restore_scheduler(
+                path + ".sched",
+                cost_model_factory=MODEL_REGISTRY[cost_model],
+                backend=backend,
+                device_resident=device_resident,
+            )
+        # mutually exclusive kinds: one restore, one increment
+        obs_metrics.get_registry().counter(
+            "ksched_restore_total",
+            "service restores by path taken",
+            labelnames=("kind",),
+        ).labels(
+            kind="warm" if restored_warm else (wal_fallback or "cold")
+        ).inc()
         svc = cls(
             api,
             max_tasks_per_pu=state["max_tasks_per_pu"],
@@ -760,14 +939,24 @@ class SchedulerService:
             span_tracer=span_tracer,
             pipeline=pipeline,
             device_resident=device_resident,
+            tenant=state.get("tenant", ""),
+            audit_every=(
+                audit_every if audit_every is not None
+                else state.get("audit_every", 0)
+            ),
             _restored=parts,
         )
+        svc.restored_warm = restored_warm
         svc.job_id = state["job_id"]
         svc.old_bindings = dict(state["old_bindings"])
-        # The pre-kill backlog flag is not checkpointed: assume dirty so
-        # the first quiet poll re-solves anything a pre-kill NOOP round
-        # or eviction left runnable, instead of starving it.
-        svc.backlog_dirty = True
+        # counters ride the sidecar (v2): ladder/NOOP continuity
+        svc.noop_rounds = state.get("noop_rounds", 0)
+        if svc.ladder is not None:
+            svc.ladder.degradations_total = state.get("degradations_total", 0)
+        # Warm restores carry the exact pre-kill backlog flag; a cold
+        # replay assumes dirty so the first quiet poll re-solves
+        # anything a pre-kill NOOP round or eviction left runnable.
+        svc.backlog_dirty = state.get("backlog_dirty", True) if restored_warm else True
         # only tasks that still exist ride along (completed pods whose
         # descriptors were dropped must not resurrect map entries)
         for pod_id, task_id in state["pod_to_task"].items():
@@ -966,6 +1155,14 @@ def main(argv=None) -> int:
                     "between rounds: after the first full upload only "
                     "packed delta records cross the host/device boundary "
                     "(graph/device_export.DeviceResidentState)")
+    ap.add_argument("--audit-every", type=int, default=0, metavar="N",
+                    help="device-state integrity audit cadence: every Nth "
+                    "round, fingerprint the persistent device buffers "
+                    "against the host journal truth and repair divergence "
+                    "through the escalating ladder "
+                    "(ksched_state_audits_total{result}; 0 = off; "
+                    "requires --device-resident — there is no persistent "
+                    "mirror to audit otherwise; runtime/integrity.py)")
     # -- observability (ksched_tpu/obs; docs/observability.md) ----------
     ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                     help="serve Prometheus text on /metricsz (+ /healthz, "
@@ -998,6 +1195,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.one_shot and args.podgen <= 0:
         ap.error("--one-shot needs --podgen N: the pod wait blocks until a first pod arrives")
+    if args.audit_every and not args.device_resident:
+        ap.error(
+            "--audit-every audits the persistent device mirror; without "
+            "--device-resident there is nothing to audit (zero audits "
+            "would run silently)"
+        )
     if args.no_obs and (args.metrics_port is not None or args.obs_dump):
         ap.error(
             "--no-obs disables the metrics registry; --metrics-port/--obs-dump "
@@ -1083,6 +1286,7 @@ def main(argv=None) -> int:
         span_tracer=span_tracer,
         pipeline=args.pipeline,
         device_resident=args.device_resident,
+        audit_every=args.audit_every,
     )
     if args.machine_timeout > 0:
         svc.enable_heartbeats(machine_timeout_s=args.machine_timeout)

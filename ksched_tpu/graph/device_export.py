@@ -882,9 +882,35 @@ class DeviceResidentState:
             self._scaled = (problem.version, scaled)
         return self._scaled[1]
 
+    def rebind(self, problem: "DeviceResidentProblem") -> None:
+        """Re-point a problem handle at the mirror's CURRENT buffers.
+        Required after any out-of-band buffer replacement (divergence
+        repair, injected corruption): the repair/poison scatters
+        produce new buffers (and repairs may donate the old ones), so
+        a handle built at refresh time would read dead or stale
+        arrays."""
+        problem.d_excess = self.d_excess
+        problem.d_src = self.d_src
+        problem.d_dst = self.d_dst
+        problem.d_cap = self.d_cap
+        problem.d_cost = self.d_cost
+        if problem.d_plan is not None and self._plan_gen >= 0:
+            problem.d_plan = (
+                self.d_p_arc, self.d_p_sign, self.d_p_src, self.d_p_dst,
+                self.d_seg, self.d_isstart, self.d_inv,
+                self.d_first, self.d_last, self.d_nonempty,
+            )
+        self._scaled = None
+
     def parity_check(self) -> None:
-        """Assert the device mirror equals the host folded view
-        bit-for-bit (fetches the buffers; test/debug only)."""
+        """Verify the device mirror equals the host folded view
+        bit-for-bit (fetches the buffers; audit/debug — the cheap
+        per-round path is the fingerprint audit in
+        runtime/integrity.py). Raises a structured IntegrityError
+        carrying a bounded diff (first-k mismatching indices,
+        expected vs found)."""
+        from ..runtime.integrity import bounded_diff
+
         problem = self.state.problem()
         pairs = (
             (self.d_excess, problem.excess.astype(np.int32)),
@@ -897,12 +923,7 @@ class DeviceResidentState:
         for name, (dev, host) in zip(names, pairs):
             got = np.asarray(dev)
             if not np.array_equal(got, host):
-                bad = np.nonzero(got != host)[0][:8]
-                raise AssertionError(
-                    f"device mirror diverged from host {name} at rows "
-                    f"{bad.tolist()}: device={got[bad].tolist()} "
-                    f"host={host[bad].tolist()}"
-                )
+                raise bounded_diff(f"device mirror {name}", got, host)
 
     def plan_parity_check(self) -> None:
         """Assert the scatter-maintained device plan tensors equal the
@@ -927,12 +948,9 @@ class DeviceResidentState:
             ("node_last", self.d_last, plan.node_last),
             ("node_nonempty", self.d_nonempty, plan.node_nonempty),
         )
+        from ..runtime.integrity import bounded_diff
+
         for name, dev, host in pairs:
             got = np.asarray(dev)
             if not np.array_equal(got, host):
-                bad = np.nonzero(got != host)[0][:8]
-                raise AssertionError(
-                    f"device plan mirror diverged from host {name} at rows "
-                    f"{bad.tolist()}: device={got[bad].tolist()} "
-                    f"host={host[bad].tolist()}"
-                )
+                raise bounded_diff(f"device plan mirror {name}", got, host)

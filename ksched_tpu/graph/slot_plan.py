@@ -260,6 +260,19 @@ class SlotPlanState:
         self._static_dev: Optional[Tuple] = None  # (layout_gen, tensors)
         self._values_dev: Optional[Tuple] = None  # (layout_gen, version, tensors)
 
+    # -- pickling (the warm-restore manifest, runtime/checkpoint.py) -------
+
+    def __getstate__(self):
+        # the device caches hold live jax buffers; they are rebuilt on
+        # first use in the restored process
+        state = dict(self.__dict__)
+        state["_static_dev"] = None
+        state["_values_dev"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
     # -- lifecycle ---------------------------------------------------------
 
     def invalidate(self) -> None:
@@ -872,8 +885,21 @@ class SlotPlanState:
     # -- invariants (tests / debug) ----------------------------------------
 
     def check_invariants(self) -> None:
-        """Assert the maintained layout is internally consistent with
-        the owning DeviceGraphState (test/debug only; O(E))."""
+        """Verify the maintained layout is internally consistent with
+        the owning DeviceGraphState (O(E)). Raises a structured
+        `runtime.integrity.IntegrityError` (an AssertionError subclass,
+        so bare-assert-era consumers keep working) — promoted from a
+        test helper to the `--audit-every` service audit surface."""
+        try:
+            self._check_invariants_impl()
+        except AssertionError as e:
+            from ..runtime.integrity import IntegrityError
+
+            if isinstance(e, IntegrityError):
+                raise
+            raise IntegrityError(f"slot-plan invariant violated: {e}", array="slot_plan") from e
+
+    def _check_invariants_impl(self) -> None:
         st = self.state
         assert not self.needs_rebuild, "plan not built"
         live = sorted(st._arc_slot.values())
@@ -912,9 +938,13 @@ class SlotPlanState:
         occ = np.bincount(
             self.p_src[self.p_sign != 0], minlength=st.n_cap
         )
-        assert np.array_equal(occ, self._occ[: st.n_cap]), (
-            "region occupancy bookkeeping diverged from live rows"
-        )
+        if not np.array_equal(occ, self._occ[: st.n_cap]):
+            from ..runtime.integrity import bounded_diff
+
+            # raised AS the structured error: check_invariants passes
+            # IntegrityError through unwrapped, keeping the
+            # machine-readable indices/expected/found fields
+            raise bounded_diff("plan_occupancy", self._occ[: st.n_cap], occ)
         assert (self._deg_hwm[: st.n_cap] >= occ).all(), (
             "degree high-water mark fell below live occupancy"
         )

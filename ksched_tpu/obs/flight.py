@@ -87,6 +87,11 @@ class FlightRecorder:
             return self._maybe_dump("noop_round")
         return None
 
+    def trigger(self, reason: str) -> Optional[str]:
+        """External dump trigger (e.g. a state-divergence event), with
+        the same per-reason rate limit as the built-in triggers."""
+        return self._maybe_dump(reason)
+
     def _maybe_dump(self, reason: str) -> Optional[str]:
         last = self._last_dump_round.get(reason)
         if last is not None and self.rounds_seen - last < self.min_rounds_between_dumps:

@@ -15,14 +15,21 @@ from .chaos import (
     FaultInjector,
 )
 from .checkpoint import (
+    CheckpointDamaged,
+    CheckpointError,
+    CheckpointMissing,
+    CheckpointVersionError,
     load_bulk_checkpoint,
     load_device_checkpoint,
+    load_warm_manifest,
     restore_scheduler,
     save_bulk_checkpoint,
     save_device_checkpoint,
     save_scheduler,
+    save_warm_manifest,
 )
 from .degrade import DegradingSolver, LadderExhausted, build_degradation_ladder
+from .integrity import IntegrityError, StateAuditor, WALCorrupted
 from .failure import HeartbeatMonitor, RoundWatchdog
 from .trace import RoundTracer
 
@@ -30,17 +37,26 @@ __all__ = [
     "ChaosBackendError",
     "ChaosClusterAPI",
     "ChaosPolicy",
+    "CheckpointDamaged",
+    "CheckpointError",
+    "CheckpointMissing",
+    "CheckpointVersionError",
     "DegradingSolver",
     "FaultInjector",
     "HeartbeatMonitor",
+    "IntegrityError",
     "LadderExhausted",
     "RoundTracer",
     "RoundWatchdog",
+    "StateAuditor",
+    "WALCorrupted",
     "build_degradation_ladder",
     "load_bulk_checkpoint",
     "load_device_checkpoint",
+    "load_warm_manifest",
     "restore_scheduler",
     "save_bulk_checkpoint",
     "save_device_checkpoint",
     "save_scheduler",
+    "save_warm_manifest",
 ]

@@ -77,6 +77,20 @@ class ChaosPolicy:
     solver_fault_prob: float = 0.0
     solver_fault_kinds: Tuple[str, ...] = SOLVER_FAULT_KINDS
     solver_total_outage_prob: float = 0.0
+    # state-corruption faults (runtime/integrity.py): a per-solve draw
+    # flips one bit of one persistent device buffer via a seeded poison
+    # scatter — the fault class the fingerprint audit must catch the
+    # round it happens
+    device_corrupt_prob: float = 0.0
+    device_corrupt_arrays: Tuple[str, ...] = (
+        "excess", "src", "dst", "cap", "cost", "p_sign",
+    )
+    # checkpoint-corruption faults: at each kill-and-restore the soak
+    # draws one of wal_drop / wal_dup / wal_torn (dropped WAL record,
+    # duplicated record, torn checkpoint write) against the warm
+    # manifest; restore must DETECT it and fall back to cold replay
+    wal_corrupt_prob: float = 0.0
+    wal_corrupt_kinds: Tuple[str, ...] = ("wal_drop", "wal_dup", "wal_torn")
 
     def __post_init__(self) -> None:
         bad = [k for k in self.solver_fault_kinds if k not in SOLVER_FAULT_KINDS]
@@ -103,12 +117,17 @@ class FaultInjector:
 
     def __init__(self, policy: ChaosPolicy) -> None:
         self.policy = policy
-        streams = np.random.SeedSequence(policy.seed).spawn(5)
+        # streams 0-4 predate the corruption domains; spawn keys are
+        # sequential, so appending streams keeps every pre-existing
+        # fixed-seed fault schedule bit-identical
+        streams = np.random.SeedSequence(policy.seed).spawn(7)
         self._rng_outage = np.random.default_rng(streams[0])
         self._rng_bind = np.random.default_rng(streams[1])
         self._rng_solver = np.random.default_rng(streams[2])
         self._rng_flap = np.random.default_rng(streams[3])
         self._rng_http = np.random.default_rng(streams[4])
+        self._rng_corrupt = np.random.default_rng(streams[5])
+        self._rng_wal = np.random.default_rng(streams[6])
         self.counters: Counter = Counter()
         # live twin of `counters` on the obs registry: the obs smoke
         # reconciles this against the tracer's per-round attribution
@@ -221,6 +240,55 @@ class FaultInjector:
         if kind is not None:
             self._count(f"solver_{kind}")
         return kind
+
+    # -- state-corruption faults (runtime/integrity.py) -------------------
+
+    def device_corruption(
+        self, n_cap: int, m_cap: int, available=None
+    ) -> Optional[dict]:
+        """One per-solve device-buffer bit-flip draw: None, or
+        {"array", "index", "bit"} for integrity.apply_device_corruption.
+        Node-space arrays index within n_cap, arc/plan-space within
+        m_cap (the applier re-mods against the live buffer extent, so
+        plan tensors sized 2*m_cap stay in range). ``available`` narrows
+        the targets to buffers that exist right now (the plan mirror is
+        built lazily) — availability is state-driven and deterministic,
+        so the schedule stays reproducible. Counted as
+        `device_bit_flip` at injection time; a draw with no live target
+        injects (and counts) nothing."""
+        if self._quiesced or self.policy.device_corrupt_prob <= 0:
+            return None
+        if self._rng_corrupt.random() >= self.policy.device_corrupt_prob:
+            return None
+        arrays = tuple(
+            a for a in self.policy.device_corrupt_arrays
+            if available is None or a in available
+        )
+        if not arrays:
+            return None
+        name = str(arrays[int(self._rng_corrupt.integers(0, len(arrays)))])
+        extent = n_cap if name == "excess" else m_cap
+        spec = {
+            "array": name,
+            "index": int(self._rng_corrupt.integers(0, max(extent, 1))),
+            "bit": int(self._rng_corrupt.integers(0, 31)),
+        }
+        self._count("device_bit_flip")
+        return spec
+
+    def checkpoint_corruption(self) -> Optional[Tuple[str, int]]:
+        """One per-checkpoint WAL corruption draw: None, or
+        (kind, seed) where kind is wal_drop/wal_dup/wal_torn and seed
+        feeds integrity.corrupt_wal_file's deterministic byte choice.
+        Counted by kind at injection time."""
+        if self._quiesced or self.policy.wal_corrupt_prob <= 0:
+            return None
+        if self._rng_wal.random() >= self.policy.wal_corrupt_prob:
+            return None
+        kinds = self.policy.wal_corrupt_kinds
+        kind = str(kinds[int(self._rng_wal.integers(0, len(kinds)))])
+        self._count(kind)
+        return kind, int(self._rng_wal.integers(0, 1 << 31))
 
     # -- HTTP faults (the fake API server hook) ---------------------------
 

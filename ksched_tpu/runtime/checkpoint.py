@@ -28,6 +28,30 @@ from ..scheduler import FlowScheduler
 from ..utils import JobMap, ResourceMap, ResourceStatus, TaskMap, resource_id_from_string
 
 CHECKPOINT_VERSION = 1
+#: warm-restore manifest (the ".wal" companion): version of the framed
+#: record stream save_warm_manifest writes
+WARM_MANIFEST_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """Base for checkpoint load failures; subclasses are DISTINCT so a
+    damaged sidecar, a missing companion, and a version mismatch each
+    surface as their own actionable error (not one opaque crash)."""
+
+
+class CheckpointDamaged(CheckpointError):
+    """Truncated / garbage checkpoint bytes (unpicklable sidecar, torn
+    write): the file exists but cannot be trusted."""
+
+
+class CheckpointMissing(CheckpointError):
+    """A required companion file of the checkpoint set is absent."""
+
+
+class CheckpointVersionError(CheckpointError, ValueError):
+    """The checkpoint was written by an incompatible version.
+    ValueError subclass for pre-r14 callers that caught the bare
+    ValueError the old version check raised."""
 #: device checkpoints: version 2 = __meta_json__ typed meta (r4+);
 #: version 1 = the pre-r4 sorted-int64 __meta_keys__/__meta__ pair.
 #: Writers stamp 2; the loader accepts both. Bumped so a pre-r4 reader
@@ -41,6 +65,21 @@ DEVICE_CHECKPOINT_VERSION = 2
 # ---------------------------------------------------------------------------
 
 
+def atomic_pickle(state, path: str) -> None:
+    """Pickle to a temp file and rename into place: a crash mid-write
+    must leave the PREVIOUS checkpoint intact, not a truncated file
+    where the last good one used to be (same discipline as the warm
+    manifest's integrity.write_records)."""
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def save_scheduler(scheduler: FlowScheduler, path: str) -> None:
     """Snapshot the world state: topology roots, jobs (task trees ride
     along via root_task.spawned), and task→PU bindings."""
@@ -52,8 +91,7 @@ def save_scheduler(scheduler: FlowScheduler, path: str) -> None:
         "bindings": dict(scheduler.task_bindings),
         "max_tasks_per_pu": scheduler.gm.max_tasks_per_pu,
     }
-    with open(path, "wb") as f:
-        pickle.dump(state, f)
+    atomic_pickle(state, path)
 
 
 def restore_scheduler(
@@ -356,3 +394,147 @@ def load_device_checkpoint(path: str, class_cost_fn=None):
             meta.get("hyb_kg", max(cluster.preempt_global_every - 1, 0))
         )
     return cluster
+
+
+# ---------------------------------------------------------------------------
+# Warm-restore manifest (journal WAL + device-state manifest)
+# ---------------------------------------------------------------------------
+#
+# The event-replay checkpoint above rebuilds only HOST scheduler state:
+# a kill-and-restore lands back on the cold full_build path (fresh node
+# ids, host argsort, full problem+plan upload, cold solver) and
+# forfeits the delta-sized warm band. The warm manifest closes that
+# gap: it snapshots the scheduler CORE (graph manager, flow graph,
+# journal state, cost model, maps — one pickle, so shared descriptor
+# identity survives), the DeviceGraphState + SlotPlanState geometry
+# (slot table, regions, high-water marks, tail pool), and the solver's
+# carried warm flow/potentials/endpoints. load_warm_manifest replays
+# the records into a rebuilt scheduler whose device mirror is primed
+# OUTSIDE any round, so the first post-restore round ships only that
+# round's delta (plan_sync `delta`, upload `delta`) and the first
+# solve is already warm — bit-identical to the never-killed process.
+#
+# The manifest rides the WAL record framing (runtime/integrity.py):
+# seq-numbered, CRC'd records, so dropped/duplicated records and torn
+# writes are detected as DISTINCT corruption kinds and the caller can
+# contain them by falling back to the cold event replay.
+
+#: scheduler attributes excluded from the core pickle (rebuilt fresh:
+#: the solver holds the backend/ladder and live device buffers)
+_SCHED_CORE_EXCLUDE = ("solver", "_round_in_flight")
+
+
+def find_jax_solver(backend):
+    """The JaxSolver whose warm state a manifest carries, if the
+    configured rung is one (a DegradingSolver is unwrapped to its
+    primary)."""
+    from ..solver.jax_solver import JaxSolver
+    from .degrade import DegradingSolver
+
+    if isinstance(backend, DegradingSolver):
+        backend = backend.primary
+    return backend if isinstance(backend, JaxSolver) else None
+
+
+def save_warm_manifest(scheduler, path: str, meta: Optional[dict] = None) -> None:
+    """Write the warm-restore manifest for a FlowScheduler (see the
+    section comment). Call at a round boundary with no round in flight
+    and pending bindings flushed — SchedulerService.save_checkpoint
+    guarantees both."""
+    from .integrity import write_records
+
+    sol = scheduler.solver
+    core = {
+        k: v for k, v in scheduler.__dict__.items() if k not in _SCHED_CORE_EXCLUDE
+    }
+    warm = None
+    jaxs = find_jax_solver(sol.backend)
+    if jaxs is not None:
+        warm = jaxs.export_warm_state()
+    payload = {
+        "scheduler": core,
+        "device_state": sol.state,
+        "started": sol._started,
+        "incremental": sol.incremental,
+    }
+    records = [
+        ("meta", json.dumps(
+            {"version": WARM_MANIFEST_VERSION, **(meta or {})}
+        ).encode()),
+        ("core", pickle.dumps(payload)),
+        ("warm", pickle.dumps(warm)),
+    ]
+    write_records(path, records)
+
+
+def load_warm_manifest(
+    path: str,
+    backend=None,
+    device_resident: bool = False,
+) -> Tuple:
+    """Rebuild a FlowScheduler (+ maps) from a warm manifest and prime
+    its device mirror. Returns ((scheduler, resource_map, job_map,
+    task_map), meta). Raises `integrity.WALCorrupted` on a damaged
+    stream and CheckpointVersionError on a version mismatch — callers
+    contain both by falling back to restore_scheduler's cold replay."""
+    from ..graph.device_export import _STATE_UIDS, DeviceResidentState
+    from ..scheduler.flow_scheduler import FlowScheduler
+    from ..solver.cpu_ref import ReferenceSolver
+    from ..solver.placement import PlacementSolver
+    from .integrity import read_records
+
+    recs = dict(read_records(path))
+    if not {"meta", "core", "warm"} <= set(recs):
+        missing = {"meta", "core", "warm"} - set(recs)
+        raise CheckpointDamaged(
+            f"warm manifest {path} is missing record(s) {sorted(missing)}"
+        )
+    meta = json.loads(recs["meta"])
+    if meta.get("version") != WARM_MANIFEST_VERSION:
+        raise CheckpointVersionError(
+            f"unsupported warm manifest version {meta.get('version')} "
+            f"(this build writes {WARM_MANIFEST_VERSION}); re-checkpoint "
+            "from a matching build or restore cold from the .sched replay"
+        )
+    payload = pickle.loads(recs["core"])
+    warm = pickle.loads(recs["warm"])
+
+    scheduler = FlowScheduler.__new__(FlowScheduler)
+    scheduler.__dict__.update(payload["scheduler"])
+    scheduler._round_in_flight = None
+    st = payload["device_state"]
+    # the uid feeds plan_key identity; a fresh process must never let a
+    # LATER DeviceGraphState collide with the restored one's key
+    old_uid = st._uid
+    st._uid = next(_STATE_UIDS)
+    # the pickled problem cache carries a plan_key built on the old
+    # uid; drop it so the next materialize re-keys on the new one
+    st._cache = None
+    st._cache_nodes_ok = False
+    st._cache_arcs_ok = False
+    sol = PlacementSolver(
+        scheduler.gm,
+        backend if backend is not None else ReferenceSolver(),
+        device_resident=device_resident,
+    )
+    sol.state = st
+    sol.resident = DeviceResidentState(st) if device_resident else None
+    sol._started = payload["started"]
+    sol.incremental = payload["incremental"]
+    scheduler.solver = sol
+    if warm is not None:
+        jaxs = find_jax_solver(sol.backend)
+        if jaxs is not None:
+            key = warm.get("key_solved")
+            if key is not None and len(key) and key[0] == old_uid:
+                key = (st._uid,) + tuple(key[1:])
+            jaxs.import_warm_state(warm, key_solved=key, resident=device_resident)
+    if sol.resident is not None:
+        # prime the mirror NOW (full upload + plan tensor ship happen
+        # at restore time, outside any round), so the first
+        # post-restore round's refresh is delta-sized
+        sol.resident.refresh()
+    return (
+        (scheduler, scheduler.resource_map, scheduler.job_map, scheduler.task_map),
+        meta,
+    )

@@ -26,11 +26,18 @@ from ..obs.metrics import get_registry
 from ..solver.base import FlowResult, FlowSolver
 from .chaos import ChaosBackendError, FaultInjector, poison_costs
 
+from .integrity import IntegrityError
+
 #: failures a rung may raise that the ladder absorbs: non-convergence /
 #: infeasibility (RuntimeError), scaled-cost or potential overflow
-#: (OverflowError et al.), rejected inputs (ValueError). Anything else
+#: (OverflowError et al.), rejected inputs (ValueError), and state-
+#: integrity failures (IntegrityError — an AssertionError subclass, so
+#: it must be named explicitly): the divergence response ladder
+#: (runtime/integrity.py) repairs in place, but if a repair itself
+#: raises through a solve, the rung steps down and the NOOP round is
+#: the documented last rung of the divergence ladder. Anything else
 #: (KeyboardInterrupt, MemoryError, bugs raising TypeError) propagates.
-DEGRADABLE_ERRORS = (RuntimeError, ValueError, ArithmeticError)
+DEGRADABLE_ERRORS = (RuntimeError, ValueError, ArithmeticError, IntegrityError)
 
 
 class LadderExhausted(RuntimeError):

@@ -612,6 +612,64 @@ class JaxSolver(FlowSolver):
         self._prev_dst_host = None
         self._key_solved = None
 
+    # -- warm-state checkpointing (runtime/checkpoint.save_warm_manifest) --
+
+    def export_warm_state(self) -> Optional[dict]:
+        """The carried warm state as host arrays, or None when cold —
+        what a warm crash restore needs to make its first solve
+        bit-identical to the never-killed process's. One D2H fetch of
+        the potentials (the flow already has a host copy)."""
+        if self._prev is None:
+            return None
+        return {
+            "prev": np.asarray(self._prev, np.int32),
+            "prev_p": (
+                np.asarray(self._prev_p, np.int32)
+                if self._prev_p is not None else None
+            ),
+            "prev_src": (
+                np.asarray(self._prev_src_host, np.int32)
+                if self._prev_src_host is not None else None
+            ),
+            "prev_dst": (
+                np.asarray(self._prev_dst_host, np.int32)
+                if self._prev_dst_host is not None else None
+            ),
+            "key_solved": self._key_solved,
+        }
+
+    def import_warm_state(
+        self, state: dict, key_solved=None, resident: bool = False
+    ) -> None:
+        """Adopt an export_warm_state payload. `key_solved` is the
+        endpoint key REMAPPED onto the restored DeviceGraphState (its
+        uid changes across processes; the checkpoint loader owns the
+        remap). With `resident`, the warm flow and the last-solve
+        endpoint masks are re-uploaded so a device-resident loop's
+        first post-restore warm attempt consumes the exact buffers the
+        killed process carried."""
+        self._prev = np.asarray(state["prev"], np.int32)
+        self._prev_p = (
+            jnp.asarray(state["prev_p"]) if state.get("prev_p") is not None else None
+        )
+        self._prev_src_host = (
+            np.asarray(state["prev_src"], np.int32)
+            if state.get("prev_src") is not None else None
+        )
+        self._prev_dst_host = (
+            np.asarray(state["prev_dst"], np.int32)
+            if state.get("prev_dst") is not None else None
+        )
+        self._key_solved = key_solved if key_solved is not None else state.get("key_solved")
+        if resident and self._prev_src_host is not None:
+            self._prev_dev = jnp.asarray(self._prev)
+            self._prev_src_dev = jnp.asarray(self._prev_src_host)
+            self._prev_dst_dev = jnp.asarray(self._prev_dst_host)
+        else:
+            self._prev_dev = None
+            self._prev_src_dev = None
+            self._prev_dst_dev = None
+
     def _plan_for(self, src: np.ndarray, dst: np.ndarray, n: int, plan_key=None) -> tuple:
         plan = self._plan
         if plan_key is not None and self._plan_key == plan_key and plan is not None:

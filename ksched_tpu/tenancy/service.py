@@ -236,6 +236,7 @@ class MultiTenantService:
         est_nodes: Optional[int] = None,
         est_arcs: Optional[int] = None,
         poll_timeout_s: float = 0.005,
+        audit_every: int = 0,
     ) -> TenantCell:
         """Admit one cell: admission control first, then the cell's
         SchedulerService is built under the tenant's scoped registry
@@ -289,6 +290,7 @@ class MultiTenantService:
                     pipeline=self.pipeline,
                     device_resident=self.device_resident,
                     tenant=tenant_id,
+                    audit_every=audit_every,
                 )
                 if machine_timeout_s > 0:
                     svc.enable_heartbeats(machine_timeout_s=machine_timeout_s)
@@ -309,6 +311,27 @@ class MultiTenantService:
         account.extra["seed"] = seed
         self.cells[tenant_id] = cell
         return cell
+
+    def save_tenant_checkpoint(self, tenant_id: str, path: str) -> None:
+        """Checkpoint ONE cell (sidecar + .sched + warm .wal manifest,
+        via its SchedulerService) under that tenant's scoped registry
+        and parked RNG stream — the per-tenant slice of the state
+        manifest: its own slot-plan geometry, warm endpoints, and
+        ladder counters, with the cell's quarantine streak riding the
+        sidecar-adjacent meta returned to the manager's account."""
+        cell = self.cells[tenant_id]
+        outer = global_rng().getstate()
+        global_rng().setstate(cell._rng_state)
+        try:
+            with obs_metrics.scoped_registry(self._scoped(tenant_id)):
+                cell.svc.save_checkpoint(path)
+            cell._rng_state = global_rng().getstate()
+        finally:
+            global_rng().setstate(outer)
+        account = self.manager.accounts.get(tenant_id)
+        if account is not None:
+            account.extra["checkpoint"] = path
+            account.extra["quarantine_streak"] = account.bad_streak
 
     def remove_tenant(self, tenant_id: str) -> None:
         cell = self.cells.pop(tenant_id, None)

@@ -69,6 +69,18 @@ class _TypedMap(Generic[V]):
         not mutating concurrently (reference: types.go UnsafeGet)."""
         return self._m
 
+    # The warm-restore manifest (runtime/checkpoint.save_warm_manifest)
+    # pickles the maps through the scheduler core; the lock is process
+    # state, not data, and RLocks don't pickle.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
+
     def __len__(self) -> int:
         return len(self._m)
 

@@ -662,3 +662,38 @@ def test_contract_catches_bucket_leak():
         return _make_jaxpr(fn, (64,))
 
     assert jc.jaxpr_hash(trace(40)) != jc.jaxpr_hash(trace(60))
+
+
+# ---------------------------------------------------------------------------
+# State-integrity fingerprint programs (runtime/integrity.py, r14)
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_programs_scatter_free_and_32bit():
+    """The integrity audit rides the normal round cadence, so its
+    checksum programs get NO scatter exemption: pure elementwise
+    multiply + reduction, all 32-bit. (The delta/plan scatter programs
+    themselves are untouched by fingerprinting — their off-hash pins
+    above hold byte-identically, which is the 'fingerprint-off traces
+    byte-identical to the r12 pins' contract.)"""
+    for name, trace in (
+        ("state_fingerprint", jc.trace_state_fingerprint()),
+        ("plan_fingerprint", jc.trace_plan_fingerprint()),
+    ):
+        report = jc.check_jaxpr(name, trace)
+        assert report.ok_scatter, (name, report.scatter_eqns)
+        assert report.ok_64bit, (name, report.violations_64bit)
+
+
+def test_fingerprint_programs_pow2_bucket_hash_stable():
+    """One compiled fingerprint program per pow2 shape bucket — the
+    audit must never force per-round recompiles."""
+    assert jc.jaxpr_hash(jc.trace_state_fingerprint(20, 100)) == jc.jaxpr_hash(
+        jc.trace_state_fingerprint(24, 110)
+    )
+    assert jc.jaxpr_hash(jc.trace_state_fingerprint(20, 100)) != jc.jaxpr_hash(
+        jc.trace_state_fingerprint(20, 300)
+    )
+    assert jc.jaxpr_hash(jc.trace_plan_fingerprint(20, 100)) == jc.jaxpr_hash(
+        jc.trace_plan_fingerprint(24, 110)
+    )

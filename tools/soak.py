@@ -173,18 +173,35 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
     )
     from ksched_tpu.solver.select import make_backend
 
-    policy = ChaosPolicy(
-        seed=args.seed,
-        api_outage_prob=0.04,
-        api_outage_rounds=(1, 3),
-        binding_drop_prob=0.08,
-        machine_flap_prob=0.008,
-        machine_flap_rounds=(2, 5),
-        solver_fault_prob=0.06,
-        solver_total_outage_prob=getattr(args, "solver_outage_prob", None)
-        if getattr(args, "solver_outage_prob", None) is not None
-        else 0.01,
-    )
+    corruption = bool(getattr(args, "corruption", False))
+    if getattr(args, "control_clean_policy", False):
+        # the recovery soak's clean control arm: zero faults of any
+        # kind, same seed — the bit-identical baseline the corruption
+        # arm must match after detection + repair
+        policy = ChaosPolicy(seed=args.seed)
+    elif corruption:
+        # the recovery soak isolates the state-corruption fault domains
+        # (device bit flips + WAL damage at kill points) so its clean
+        # control arm is comparable bit-for-bit; the mixed-domain fault
+        # schedule stays covered by chaos/obs/pipeline smokes
+        policy = ChaosPolicy(
+            seed=args.seed,
+            device_corrupt_prob=0.25,
+            wal_corrupt_prob=float(getattr(args, "wal_chaos", 0.0)),
+        )
+    else:
+        policy = ChaosPolicy(
+            seed=args.seed,
+            api_outage_prob=0.04,
+            api_outage_rounds=(1, 3),
+            binding_drop_prob=0.08,
+            machine_flap_prob=0.008,
+            machine_flap_rounds=(2, 5),
+            solver_fault_prob=0.06,
+            solver_total_outage_prob=getattr(args, "solver_outage_prob", None)
+            if getattr(args, "solver_outage_prob", None) is not None
+            else 0.01,
+        )
     injector = FaultInjector(policy)
     api = ChaosClusterAPI(SyntheticClusterAPI(), injector)
     tracer = RoundTracer()
@@ -210,6 +227,16 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
 
     pipeline = getattr(args, "loop", "sync") == "pipelined"
     device_resident = bool(getattr(args, "device_resident", False))
+    if getattr(args, "corruption", False):
+        device_resident = True  # the poison scatter needs a device mirror
+
+    audit_every = int(getattr(args, "audit_every", 0) or 0)
+    if corruption:
+        # corruption mode pins the cadence to 1: the soak's acceptance
+        # (every flip detected the round it happens, divergences ==
+        # injected flips) is only well-defined per-round — a sparser
+        # cadence would collapse multiple flips into one detection
+        audit_every = 1
 
     def make_service():
         return SchedulerService(
@@ -224,6 +251,7 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
             span_tracer=span_tracer,
             pipeline=pipeline,
             device_resident=device_resident,
+            audit_every=audit_every,
         )
 
     svc = make_service()
@@ -236,6 +264,17 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
     cooldown = 16  # fault-free tail so dropped bindings settle
     total_rounds = args.rounds + cooldown
     restores = 0
+    warm_restores = 0
+    from collections import Counter as _Counter
+
+    integrity_totals: _Counter = _Counter()  # summed across restores
+    all_latencies: list = []  # round latencies summed across restores
+    awaiting_recovery = False  # assert the first post-restore SOLVED round
+    restore_had_warm_solver = False
+    restore_caps = (0, 0)  # pow2 buckets at restore (growth waiver)
+    restore_overflows = 0  # plan overflow count at restore (rebuild waiver)
+    recovery_strict = 0  # recovery rounds that held the delta-kind asserts
+    recovery_latencies: list = []
     t0 = time.perf_counter()
 
     for r in range(total_rounds):
@@ -285,6 +324,58 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
         pods = api.poll_pod_batch(0.005)
         svc.run_round(pods, now=now)
 
+        # first post-restore SOLVED round: warm restores must resume on
+        # the delta-sized warm path — no full_build export, delta plan
+        # sync, fresh/warm solve scope — and its latency is reported
+        # alongside the p50/p99 summary (the recovery-round cost class)
+        if awaiting_recovery and tracer.records and tracer.records[-1].solver_rung >= 0:
+            rec = tracer.records[-1]
+            sol = svc.scheduler.solver
+            lat = svc.round_latencies_s[-1] if svc.round_latencies_s else 0.0
+            recovery_latencies.append(lat)
+            scope = kind = plan_kind = "-"
+            st = sol.state
+            # a pow2 bucket growth landing on this very round rebuilds
+            # the mirror legitimately (it would without the kill too) —
+            # the delta-kind asserts apply when the bucket held
+            grew = (st.n_cap, st.m_cap) != restore_caps
+            if svc.restored_warm and rec.solver_rung == 0 and not rec.noop_round:
+                assert sol._started, (
+                    f"post-restore round {r + 1} fell back to the cold "
+                    "full_build export path"
+                )
+                overflowed = (
+                    sol.state.plan.region_overflows > restore_overflows
+                )
+                if sol.resident is not None and not grew and not overflowed:
+                    kind = sol.resident.last_upload_kind
+                    plan_kind = sol.resident.last_plan_kind
+                    assert kind == "delta", (
+                        f"post-restore round {r + 1} re-uploaded the problem "
+                        f"wholesale (upload kind {kind!r}, want 'delta')"
+                    )
+                    assert plan_kind in ("delta", "clean"), (
+                        f"post-restore round {r + 1} rebuilt the CSR plan "
+                        f"(plan sync {plan_kind!r}, want delta/clean)"
+                    )
+                    recovery_strict += 1
+                from ksched_tpu.runtime.checkpoint import find_jax_solver
+
+                jaxs = find_jax_solver(sol.backend)
+                if jaxs is not None and restore_had_warm_solver:
+                    scope = jaxs.last_warm_scope
+                    assert scope in ("warm", "fresh"), (
+                        f"post-restore round {r + 1} solved COLD "
+                        f"(scope {scope!r}): the warm endpoints did not survive"
+                    )
+            log(
+                f"recovery round {r + 1}: latency={lat * 1e3:.2f}ms "
+                f"upload={kind} plan_sync={plan_kind} warm_scope={scope} "
+                f"(restored_warm={svc.restored_warm})",
+                flush=True,
+            )
+            awaiting_recovery = False
+
         # machines the sweep expired rejoin (as fresh registrations) later
         for node_id in sorted(set(nodes_before) - set(svc.node_to_machine)):
             pending_rejoin.append((r + 5, node_id))
@@ -308,9 +399,25 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
             and r < args.rounds
             and (r + 1) % args.chaos_restore_every == 0
         ):
+            # this service object dies here: bank its integrity totals
+            # and its latency history (round_latencies_s resets with it)
+            integrity_totals.update(svc.scheduler.solver.integrity_counts)
+            all_latencies.extend(svc.round_latencies_s)
             with tempfile.TemporaryDirectory() as td:
                 ckpt = os.path.join(td, "svc.ckpt")
                 svc.save_checkpoint(ckpt)
+                # checkpoint chaos: damage the warm manifest the way a
+                # torn write / dropped / duplicated WAL record would —
+                # restore must DETECT it (never load garbage) and fall
+                # back to the cold event replay
+                wal_fault = injector.checkpoint_corruption()
+                if wal_fault is not None:
+                    from ksched_tpu.runtime.integrity import corrupt_wal_file
+
+                    kind, wal_seed = wal_fault
+                    corrupt_wal_file(
+                        ckpt + ".wal", kind, np.random.default_rng(wal_seed)
+                    )
                 before_bindings = dict(svc.scheduler.task_bindings)
                 before_pods = dict(svc.pod_to_task)
                 svc = SchedulerService.restore(
@@ -326,6 +433,16 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
                     pipeline=pipeline,
                     device_resident=device_resident,
                 )
+            if wal_fault is not None:
+                assert not svc.restored_warm, (
+                    f"restore at round {r + 1} loaded a CORRUPTED warm "
+                    f"manifest ({wal_fault[0]}) instead of detecting it"
+                )
+            else:
+                assert svc.restored_warm, (
+                    f"restore at round {r + 1} fell back to cold replay "
+                    "with an intact warm manifest"
+                )
             svc.enable_heartbeats(machine_timeout_s=hb_timeout_s, task_timeout_s=1e9)
             assert dict(svc.scheduler.task_bindings) == before_bindings, (
                 f"checkpoint restore changed bindings at round {r + 1}"
@@ -335,6 +452,16 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
             )
             check_service_invariants(svc, f"after restore at round {r + 1}")
             restores += 1
+            if svc.restored_warm:
+                warm_restores += 1
+            from ksched_tpu.runtime.checkpoint import find_jax_solver
+
+            _j = find_jax_solver(svc.scheduler.solver.backend)
+            restore_had_warm_solver = _j is not None and _j._prev is not None
+            st = svc.scheduler.solver.state
+            restore_caps = (st.n_cap, st.m_cap)
+            restore_overflows = st.plan.region_overflows
+            awaiting_recovery = True
 
     # every injected fault must be attributed to some round's record
     attributed: dict = {}
@@ -347,6 +474,8 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
     )
     noops = sum(1 for rec in tracer.records if rec.noop_round)
     degr = sum(rec.degradations for rec in tracer.records)
+    integrity_totals.update(svc.scheduler.solver.integrity_counts)
+    all_latencies.extend(svc.round_latencies_s)
     dt = time.perf_counter() - t0
     # a pipelined loop holds the final round's POSTs for a dispatch
     # window that will never come; flush before reading api.bindings()
@@ -360,8 +489,28 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
         f"CHAOS SOAK OK: {total_rounds} rounds in {dt:.1f}s — "
         f"faults={dict(sorted(injector.counters.items()))} "
         f"degradations={degr} noop_rounds={noops} restores={restores} "
-        f"final_bound={len(placements)}"
+        f"(warm={warm_restores}) final_bound={len(placements)}"
     )
+    if integrity_totals or recovery_latencies:
+        lat_ms = sorted(x * 1e3 for x in recovery_latencies)
+        lats = sorted(x * 1e3 for x in all_latencies) or [0.0]
+        p50 = lats[len(lats) // 2]
+        p99 = lats[min(len(lats) - 1, (99 * len(lats)) // 100)]
+        log(
+            f"INTEGRITY: audits "
+            f"divergences={integrity_totals.get('divergences', 0)} "
+            f"repairs={{"
+            + ", ".join(
+                f"{k[len('repair_'):]}: {v}"
+                for k, v in sorted(integrity_totals.items())
+                if k.startswith("repair_")
+            )
+            + "} "
+            f"device_flips={injector.counters.get('device_bit_flip', 0)}; "
+            f"recovery rounds "
+            f"{[f'{x:.1f}ms' for x in lat_ms]} vs service p50={p50:.1f}ms "
+            f"p99={p99:.1f}ms"
+        )
     if span_tracer is not None:
         span_tracer.uninstall()
     if getattr(args, "assert_stall_flight", False):
@@ -421,6 +570,16 @@ def _chaos_soak_body(args, reg, server, log=print) -> dict:
         "degradations": degr,
         "rounds": len(tracer.records),
         "restores": restores,
+        "warm_restores": warm_restores,
+        "divergences": integrity_totals.get("divergences", 0),
+        "repairs": {
+            k[len("repair_"):]: v
+            for k, v in integrity_totals.items()
+            if k.startswith("repair_")
+        },
+        "device_flips": injector.counters.get("device_bit_flip", 0),
+        "recovery_strict": recovery_strict,
+        "recovery_latencies_s": recovery_latencies,
     }
 
 
@@ -612,6 +771,71 @@ def run_tenant_soak(args, log=print) -> int:
 def chaos_main(args) -> int:
     import copy
 
+    if getattr(args, "verify_recovery", False):
+        # The state-integrity acceptance check (make recovery-smoke):
+        # a corruption soak (seeded device bit flips, per-round audits,
+        # mid-soak kill-and-restores through the warm manifest) must be
+        # bit-identical to a CLEAN control run with no corruption and
+        # no kills — every injected corruption detected and repaired
+        # the round it happened, every restore resuming warm on the
+        # delta-sized path — and the clean control run must report
+        # ZERO divergence events (no false positives).
+        rec_args = copy.copy(args)
+        rec_args.corruption = True
+        rec_args.device_resident = True
+        print("--- recovery arm: corruption + kills ---", flush=True)
+        recovered = run_chaos_soak(rec_args)
+        ctl_args = copy.copy(args)
+        ctl_args.corruption = False
+        ctl_args.audit_every = 1
+        ctl_args.device_resident = True
+        ctl_args.chaos_restore_every = 0
+        # the control must see the same (empty) fault schedule the
+        # corruption policy produces on its other domains
+        ctl_args.solver_outage_prob = 0.0
+        ctl_args.control_clean_policy = True
+        print("--- control arm: clean, no kills ---", flush=True)
+        control = run_chaos_soak(ctl_args)
+        assert recovered["device_flips"] > 0, (
+            "corruption soak injected no device bit flips — raise "
+            "--rounds or the corrupt probability"
+        )
+        assert recovered["divergences"] == recovered["device_flips"], (
+            f"DETECTION GAP: {recovered['device_flips']} injected flips "
+            f"but only {recovered['divergences']} divergences detected"
+        )
+        assert sum(recovered["repairs"].values()) >= recovered["divergences"], (
+            f"unrepaired divergences: {recovered['repairs']} vs "
+            f"{recovered['divergences']} detections"
+        )
+        assert recovered["restores"] >= 2 and recovered["warm_restores"] == recovered["restores"], (
+            f"expected every mid-soak kill to restore WARM: "
+            f"{recovered['warm_restores']}/{recovered['restores']}"
+        )
+        assert recovered["recovery_strict"] >= 1, (
+            "no recovery round held the strict delta-sized cost-class "
+            "asserts (every restore collided with a pow2 bucket growth "
+            "— move --chaos-restore-every)"
+        )
+        assert control["divergences"] == 0, (
+            f"FALSE POSITIVES: clean control run reported "
+            f"{control['divergences']} divergence event(s)"
+        )
+        for key in ("placements", "all_bindings"):
+            assert recovered[key] == control[key], (
+                f"corruption+kill soak diverged from the clean control: "
+                f"{key} differs"
+            )
+        print(
+            "RECOVERY SOAK OK: "
+            f"{recovered['device_flips']} corruptions all detected within "
+            f"their round and repaired ({recovered['repairs']}), "
+            f"{recovered['restores']} kill-and-restores all resumed warm "
+            "on the delta-sized path, placements bit-identical to the "
+            "clean control run, zero false positives"
+        )
+        return 0
+
     if getattr(args, "verify_loop_parity", False):
         # The pipeline-parity acceptance check: the SAME seeded chaos
         # soak through the synchronous, pipelined, and pipelined+
@@ -715,6 +939,28 @@ def main() -> int:
                     help="chaos mode: keep the flow problem device-"
                     "resident between rounds (delta-record scatter "
                     "instead of full re-uploads)")
+    ap.add_argument("--corruption", action="store_true",
+                    help="chaos mode: inject state-corruption faults — "
+                    "seeded device-buffer bit flips via the poison "
+                    "scatter (detected by the per-round fingerprint "
+                    "audit and repaired by the divergence ladder; "
+                    "implies --device-resident and --audit-every 1)")
+    ap.add_argument("--wal-chaos", type=float, default=0.0, metavar="P",
+                    help="corruption mode: probability a kill-point "
+                    "checkpoint's warm manifest is damaged (dropped/"
+                    "duplicated WAL record or torn write); restore must "
+                    "detect it and fall back to cold replay")
+    ap.add_argument("--audit-every", type=int, default=0, metavar="N",
+                    help="chaos mode: device-state integrity audit "
+                    "cadence (0 = off; --corruption always pins it to 1 "
+                    "— its per-round detection asserts need the "
+                    "every-round cadence)")
+    ap.add_argument("--verify-recovery", action="store_true",
+                    help="chaos mode: the state-integrity acceptance "
+                    "soak — corruption faults + mid-soak kills vs a "
+                    "clean control run; asserts 100%% detection, zero "
+                    "false positives, warm delta-sized restores, and "
+                    "bit-identical placements (make recovery-smoke)")
     ap.add_argument("--verify-loop-parity", action="store_true",
                     help="chaos mode: run the soak through the sync, "
                     "pipelined, and pipelined+device-resident loops and "
