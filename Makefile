@@ -29,6 +29,14 @@
 #                     detection (fingerprint audits), zero false
 #                     positives, warm delta-sized restores, and
 #                     bit-identical placements (docs/robustness.md)
+#   make shard-smoke  multi-chip rung churn soak on the virtual
+#                     8-device CPU mesh: sharded placements
+#                     bit-identical to the single-chip scan-CSR arm
+#                     over the same layout, delta-sized sharded plan
+#                     syncs after warm-up (zero layout rebuilds, zero
+#                     build_sharded_plan argsorts), chaos containment
+#                     via the sharded -> jax -> cpu_ref ladder
+#                     (docs/sharding.md)
 #   make bench-gate   check BENCH_TRAJECTORY.jsonl: fail if any config's
 #                     newest p50 regressed >15% vs its previous entry,
 #                     or its supersteps_p50 regressed >25% (+8 slack)
@@ -44,7 +52,7 @@ SHELL := /bin/bash
 PY ?= python
 LINT_PATHS = ksched_tpu tools bench.py
 
-.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke recovery-smoke bench-gate verify baseline
+.PHONY: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke recovery-smoke shard-smoke bench-gate verify baseline
 
 lint:
 	$(PY) -m tools.kschedlint $(LINT_PATHS)
@@ -76,6 +84,10 @@ recovery-smoke:
 	  --rounds 512 --chunk 128 --seed 11 --machines 6 --slots 8 \
 	  --chaos-restore-every 128 --verify-recovery
 
+shard-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PY) tools/shard_smoke.py \
+	  --machines 6 --tasks 48 --rounds 24 --warmup 4 --devices 8 --seed 7
+
 bench-gate:
 	$(PY) tools/bench_compare.py gate BENCH_TRAJECTORY.jsonl
 
@@ -88,7 +100,7 @@ test:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-verify: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke recovery-smoke
+verify: lint test chaos-smoke obs-smoke pipeline-smoke tenant-smoke recovery-smoke shard-smoke
 
 baseline:
 	$(PY) -m tools.kschedlint --write-baseline $(LINT_PATHS)

@@ -14,17 +14,21 @@ assert:
 - **no-scatter**: zero scatter-family primitives in any backend's
   solve. TPU serializes scatter-adds (~68 ms for a 64k segment_sum,
   jax_solver.py header); every segment reduction must stay in
-  cumsum/gather/associative-scan form. Exactly TWO programs hold
-  scoped exemptions, both O(churn)-sized once-per-round maintenance
+  cumsum/gather/associative-scan form. Exactly THREE programs hold
+  scoped exemptions, all O(churn)-sized once-per-round maintenance
   scatters that run OUTSIDE every solve: the device-resident problem
   delta apply (graph/device_export.delta_apply_fn, pinned by
-  `trace_delta_apply`) and the slot-stable plan-row apply
-  (graph/slot_plan.plan_apply_fn, pinned by `trace_plan_apply`). Each
+  `trace_delta_apply`), the slot-stable plan-row apply
+  (graph/slot_plan.plan_apply_fn, pinned by `trace_plan_apply`), and
+  the per-shard routed sharded plan apply (parallel/sharded_solver.
+  sharded_plan_apply_fn, pinned by `trace_sharded_plan_apply`). Each
   pin asserts the exemption is real (the program actually scatters),
   stays 32-bit, and hashes stably within a pow2 record bucket; every
   solver program stays at zero — including the slot-stable solve
-  variant (`trace_jax_slot_stable`) and the dirty-frontier warm-price
-  refit (`trace_jax_warmp`).
+  variant (`trace_jax_slot_stable`), the dirty-frontier warm-price
+  refit (`trace_jax_warmp`), and the slot-stable SHARDED solve
+  (`trace_sharded_slot`, additionally hash-stable per shard-count
+  bucket at 2/4/8 devices).
 - **mega gather budget** (locking in the megakernel's zero-HBM-gather
   claim, ops/mcmf_pallas.py): inside the mega `pallas_call` body every
   operand is VMEM/SMEM-resident by BlockSpec construction, the only
@@ -424,6 +428,130 @@ def trace_sharded(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0)
         _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()), _sds(()),
         *plan_sds,
     )
+
+
+def _mesh_of(num_devices: int):
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    assert len(devices) >= num_devices, (
+        f"need {num_devices} devices for the sharded contracts "
+        "(conftest forces an 8-device virtual CPU mesh)"
+    )
+    return Mesh(np.array(devices[:num_devices]), ("x",))
+
+
+def trace_sharded_slot(
+    n_raw: int,
+    m_raw: int,
+    num_devices: int = 2,
+    telemetry_cap: int = 0,
+    use_warm_p: bool = False,
+):
+    """Abstract trace of the slot-stable SHARDED solve
+    (parallel/sharded_solver.make_sharded_slot_solver): entry tensors
+    stacked [D, Es] with Es the pow2 per-shard block extent — a
+    function of the (m-bucket, shard count) alone, never the raw size,
+    which is what the shard-count-bucket hash pins assert."""
+    from ..parallel.sharded_solver import (
+        make_sharded_slot_solver,
+        sharded_entry_extent,
+    )
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    D = num_devices
+    es = sharded_entry_extent(m, D)
+    mesh = _mesh_of(D)
+    fn = make_sharded_slot_solver(
+        mesh, "x", alpha=8, max_supersteps=4096,
+        telemetry_cap=telemetry_cap, use_warm_p=use_warm_p,
+    )
+    args = [
+        _sds((m,)), _sds((m,)), _sds((n,)), _sds((m,)), _sds(()), _sds(()),
+        _sds((D, es)), _sds((D, es)), _sds((D, es)), _sds((D, es)),
+        _sds((D, es)), _sds((D, es), jnp.bool_),
+        _sds((2 * m,)), _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+    ]
+    if use_warm_p:
+        args.append(_sds((n,)))
+    return jax.make_jaxpr(fn)(*args)
+
+
+def trace_sharded_plan_apply(
+    kp_raw: int, ks_raw: int, num_devices: int = 2,
+    n_raw: int = 20, m_raw: int = 100,
+):
+    """Abstract trace of the THIRD scatter-exempt program: the
+    per-shard routed plan-row + segment-static apply
+    (parallel/sharded_solver.sharded_plan_apply_fn) over pow2-bucketed
+    per-shard record counts."""
+    from ..graph.device_export import pad_record_count
+    from ..graph.slot_plan import PLAN_RECORD_COLS, SEG_RECORD_COLS
+    from ..parallel.sharded_solver import (
+        sharded_entry_extent,
+        sharded_plan_apply_fn,
+    )
+
+    _n, m = bucketed_sizes(n_raw, m_raw)
+    D = num_devices
+    es = sharded_entry_extent(m, D)
+    kp = pad_record_count(kp_raw)
+    ks = pad_record_count(ks_raw)
+    fn = sharded_plan_apply_fn(_mesh_of(D), "x")
+    return jax.make_jaxpr(fn)(
+        _sds((D, es)), _sds((D, es)), _sds((D, es)), _sds((D, es)),
+        _sds((D, es)), _sds((D, es), jnp.bool_),
+        _sds((D, kp, PLAN_RECORD_COLS)), _sds((D, ks, SEG_RECORD_COLS)),
+    )
+
+
+def trace_sharded_plan_fingerprint(num_devices: int = 2, n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the sharded plan fingerprint (per-shard
+    global-weight partials psum'd to one comparable checksum) — an
+    audit program on the normal round cadence, so NO scatter
+    exemption."""
+    from ..parallel.sharded_solver import (
+        sharded_entry_extent,
+        sharded_plan_fingerprint_fn,
+    )
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    D = num_devices
+    es = sharded_entry_extent(m, D)
+    fn = sharded_plan_fingerprint_fn(_mesh_of(D), "x")
+    return jax.make_jaxpr(fn)(
+        _sds((D, es)), _sds((D, es)), _sds((D, es)), _sds((D, es)),
+        _sds((2 * m,)), _sds((D, es)), _sds((D, es), jnp.bool_),
+        _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+    )
+
+
+#: collective primitive families counted by count_collectives — the
+#: ICI traffic classes of a sharded program
+_COLLECTIVE_PRIMS = ("psum", "pmin", "pmax", "all_gather", "all_to_all", "ppermute")
+
+
+def count_collectives(closed, loop_only: bool = False) -> Dict[str, int]:
+    """Occurrences of each collective primitive in the traced program
+    (loop bodies count ONCE — multiply by superstep counts for traffic
+    totals). With ``loop_only`` only eqns inside while/scan bodies
+    count — the per-superstep ICI reduction budget of a sharded solve
+    (prologue/one-shot collectives excluded). The bench's
+    ICI-reduction assertions read both views."""
+    counts: Dict[str, int] = {}
+    for eqn, _p, in_loop in walk_eqns(closed.jaxpr):
+        if loop_only and not in_loop:
+            continue
+        name = eqn.primitive.name
+        for prim in _COLLECTIVE_PRIMS:
+            if name == prim or name.startswith(prim + "_"):
+                counts[prim] = counts.get(prim, 0) + 1
+    return counts
+
+
+def count_superstep_collectives(closed) -> Dict[str, int]:
+    """Loop-body-only view of :func:`count_collectives`."""
+    return count_collectives(closed, loop_only=True)
 
 
 def trace_jax_warmp(n_raw: int, m_raw: int, seed: int = 0, telemetry_cap: int = 0,

@@ -648,6 +648,11 @@ class DeviceResidentState:
         self.last_plan_kind = "none"  # none | rebuild | delta | clean
         self.last_plan_bytes = 0
         self.last_plan_records = 0
+        #: sharded plan mirror mode (enable_sharded_plan): the entry-
+        #: shaped plan tensors are maintained as [D, Es] stacked
+        #: per-shard tables and the round's records route to their
+        #: owner shards — None = single-chip mirror (the default)
+        self._shard = None  # (mesh, axis, num_shards)
 
     # -- packing -----------------------------------------------------------
 
@@ -778,48 +783,59 @@ class DeviceResidentState:
             plan_key=st.plan_key(),
         )
 
-    def _sync_plan(self):
-        """Mirror the slot-stable plan (graph/slot_plan.py) as
-        persistent device tensors. Inactive until a slot-stable
-        consumer enables the plan (so non-jax backends pay nothing);
-        afterwards each round ships only the dirty plan rows / inv
-        entries through the ONE jit'd plan scatter, and the full
-        re-upload survives only on layout rebuilds (full_build, pow2
-        bucket growth, region overflow). Returns the plan tensors in
-        `_solve_mcmf` order, or None while inactive."""
-        from ..obs.spans import span
+    def enable_sharded_plan(self, mesh, axis: str = "x") -> None:
+        """Maintain the slot-plan mirror in SHARDED form for the
+        multi-chip rung (parallel/sharded_solver.py): the owning
+        SlotPlanState switches to per-shard block layout, the
+        entry-shaped device tensors become [D, Es] stacked tables
+        placed by the partition rules (entry tables partitioned on the
+        mesh axis, everything else replicated), and each round's dirty
+        rows/segment statics ship as per-shard routed records through
+        the donated shard_map scatter. Idempotent per (mesh, axis)."""
+        D = int(mesh.shape[axis])
+        if self._shard is not None and self._shard[0] is mesh and self._shard[1] == axis:
+            return
+        self._shard = (mesh, axis, D)
+        self.state.plan.enable_sharding(D)
+        self._plan_gen = -1  # mode flip: next sync re-uploads wholesale
 
-        plan = self.state.plan
-        self.last_plan_kind = "none"
-        self.last_plan_bytes = 0
-        self.last_plan_records = 0
-        if plan is None or not plan.enabled:
-            return None
+    def _upload_plan_full(self, plan) -> None:
+        """Fresh plan buffers from the host truth — the rebuild path
+        AND the integrity ladder's reupload rung. In sharded mode the
+        entry-shaped tensors are placed as [D, Es] stacked tables on
+        the mesh; the rest replicate."""
         import jax.numpy as jnp
 
-        plan.ensure_built()
-        if self._plan_gen != plan.layout_gen:
-            # layout rebuilt: fresh buffers all around (they will be
-            # donated by later scatters, so never share the plan's own
-            # full-upload cache)
-            with span("plan_upload", kind="rebuild"):
-                self.d_p_arc = jnp.asarray(plan.p_arc)
-                self.d_p_sign = jnp.asarray(plan.p_sign)
-                self.d_p_src = jnp.asarray(plan.p_src)
-                self.d_p_dst = jnp.asarray(plan.p_dst)
-                self.d_inv = jnp.asarray(plan.inv_order)
-                self.d_seg = jnp.asarray(plan.seg_start)
-                self.d_isstart = jnp.asarray(plan.is_start)
-                self.d_first = jnp.asarray(plan.node_first)
-                self.d_last = jnp.asarray(plan.node_last)
-                self.d_nonempty = jnp.asarray(plan.node_nonempty)
-            plan.clear_pending()
-            self._plan_gen = plan.layout_gen
-            self._plan_ver = plan.value_version
-            self.last_plan_kind = "rebuild"
-            self.last_plan_bytes = plan.values_nbytes() + plan.static_nbytes()
-            self.last_upload_bytes += self.last_plan_bytes
-        elif plan.value_version != self._plan_ver or plan.has_pending:
+        if self._shard is None:
+            self.d_p_arc = jnp.asarray(plan.p_arc)
+            self.d_p_sign = jnp.asarray(plan.p_sign)
+            self.d_p_src = jnp.asarray(plan.p_src)
+            self.d_p_dst = jnp.asarray(plan.p_dst)
+            self.d_inv = jnp.asarray(plan.inv_order)
+            self.d_seg = jnp.asarray(plan.seg_start)
+            self.d_isstart = jnp.asarray(plan.is_start)
+            self.d_first = jnp.asarray(plan.node_first)
+            self.d_last = jnp.asarray(plan.node_last)
+            self.d_nonempty = jnp.asarray(plan.node_nonempty)
+            return
+        from ..parallel.sharded_solver import place_sharded_plan
+
+        mesh, axis, D = self._shard
+        (
+            self.d_p_arc, self.d_p_sign, self.d_p_src, self.d_p_dst,
+            self.d_seg, self.d_isstart, self.d_inv,
+            self.d_first, self.d_last, self.d_nonempty,
+        ) = place_sharded_plan(
+            mesh, axis, plan.host_args(), D, plan.block_extent
+        )
+
+    def _scatter_plan_delta(self, plan) -> Tuple[int, int]:
+        """Apply a round's dirty plan records; (bytes, records)."""
+        import jax.numpy as jnp
+
+        from ..obs.spans import span
+
+        if self._shard is None:
             from .slot_plan import plan_apply_fn
 
             row_rec, inv_rec, seg_rec, node_rec = plan.drain_records()
@@ -842,12 +858,102 @@ class DeviceResidentState:
                     jnp.asarray(row_rec), jnp.asarray(inv_rec),
                     jnp.asarray(seg_rec), jnp.asarray(node_rec),
                 )
+            records = (
+                len(row_rec) + len(inv_rec) + len(seg_rec) + len(node_rec)
+            )
+            return rec_bytes, records
+        from ..parallel.sharded_solver import (
+            replicated_plan_apply_fn,
+            sharded_plan_apply_fn,
+        )
+
+        mesh, axis, D = self._shard
+        row_rec, seg_rec, inv_rec, node_rec = plan.drain_records_sharded()
+        rec_bytes = (
+            row_rec.nbytes + seg_rec.nbytes
+            + inv_rec.nbytes + node_rec.nbytes
+        )
+        with span(
+            "plan_upload", kind="sharded_delta", bytes=rec_bytes, shards=D
+        ):
+            (
+                self.d_p_arc, self.d_p_sign, self.d_p_src, self.d_p_dst,
+                self.d_seg, self.d_isstart,
+            ) = sharded_plan_apply_fn(mesh, axis)(
+                self.d_p_arc, self.d_p_sign, self.d_p_src, self.d_p_dst,
+                self.d_seg, self.d_isstart,
+                jnp.asarray(row_rec), jnp.asarray(seg_rec),
+            )
+            (
+                self.d_inv, self.d_first, self.d_last, self.d_nonempty,
+            ) = replicated_plan_apply_fn()(
+                self.d_inv, self.d_first, self.d_last, self.d_nonempty,
+                jnp.asarray(inv_rec), jnp.asarray(node_rec),
+            )
+        records = (
+            int(np.prod(row_rec.shape[:2])) + int(np.prod(seg_rec.shape[:2]))
+            + len(inv_rec) + len(node_rec)
+        )
+        return rec_bytes, records
+
+    def plan_fingerprints(self) -> np.ndarray:
+        """uint32 checksum per mirrored plan tensor, FP_PLAN_ARRAYS
+        order — the sharded mirror psums per-shard partials with
+        global-index weights, so both modes compare against the SAME
+        host twins (runtime/integrity.StateAuditor)."""
+        bufs = (
+            self.d_p_arc, self.d_p_sign, self.d_p_src, self.d_p_dst,
+            self.d_inv, self.d_seg, self.d_isstart,
+            self.d_first, self.d_last, self.d_nonempty,
+        )
+        if self._shard is None:
+            from ..runtime.integrity import device_fingerprints
+
+            return device_fingerprints(bufs)
+        from ..parallel.sharded_solver import sharded_plan_fingerprint_fn
+
+        mesh, axis, _D = self._shard
+        fps = sharded_plan_fingerprint_fn(mesh, axis)(*bufs)
+        return np.asarray(fps).astype(np.int32).view(np.uint32)
+
+    def _sync_plan(self):
+        """Mirror the slot-stable plan (graph/slot_plan.py) as
+        persistent device tensors. Inactive until a slot-stable
+        consumer enables the plan (so non-jax backends pay nothing);
+        afterwards each round ships only the dirty plan rows / inv
+        entries through the ONE jit'd plan scatter (per-shard routed
+        in sharded mode), and the full re-upload survives only on
+        layout rebuilds (full_build, pow2 bucket growth, region
+        overflow). Returns the plan tensors in `_solve_mcmf` order
+        (entry-shaped ones stacked [D, Es] in sharded mode), or None
+        while inactive."""
+        from ..obs.spans import span
+
+        plan = self.state.plan
+        self.last_plan_kind = "none"
+        self.last_plan_bytes = 0
+        self.last_plan_records = 0
+        if plan is None or not plan.enabled:
+            return None
+        plan.ensure_built()
+        if self._plan_gen != plan.layout_gen:
+            # layout rebuilt: fresh buffers all around (they will be
+            # donated by later scatters, so never share the plan's own
+            # full-upload cache)
+            with span("plan_upload", kind="rebuild"):
+                self._upload_plan_full(plan)
+            plan.clear_pending()
+            self._plan_gen = plan.layout_gen
+            self._plan_ver = plan.value_version
+            self.last_plan_kind = "rebuild"
+            self.last_plan_bytes = plan.values_nbytes() + plan.static_nbytes()
+            self.last_upload_bytes += self.last_plan_bytes
+        elif plan.value_version != self._plan_ver or plan.has_pending:
+            rec_bytes, records = self._scatter_plan_delta(plan)
             self._plan_ver = plan.value_version
             self.last_plan_kind = "delta"
             self.last_plan_bytes = rec_bytes
-            self.last_plan_records = (
-                len(row_rec) + len(inv_rec) + len(seg_rec) + len(node_rec)
-            )
+            self.last_plan_records = records
             self.last_upload_bytes += self.last_plan_bytes
         else:
             self.last_plan_kind = "clean"
@@ -952,5 +1058,7 @@ class DeviceResidentState:
 
         for name, dev, host in pairs:
             got = np.asarray(dev)
+            if got.ndim > 1:  # sharded [D, Es] stacking of the [E] host tensor
+                got = got.reshape(-1)
             if not np.array_equal(got, host):
                 raise bounded_diff(f"device plan mirror {name}", got, host)

@@ -98,6 +98,21 @@ the same move `scheduler/bulk.py` makes by pre-wiring arc endpoints:
 Entry position 0 is permanently reserved and dead: freed slots'
 `inv_order` rows are parked there, so a stale slot can never alias a
 live row's push allocation.
+
+SHARDED layout mode (``enable_sharding(D)``, the multi-chip rung —
+parallel/sharded_solver.py): the table is laid out as D equal-extent
+per-shard BLOCKS, block d holding exactly the regions of the nodes
+shard d owns (``shard_owner``'s contiguous id ranges — and regions
+were ALWAYS allocated in node-id order, so this is the same layout
+with per-block bases). Each block reserves its local position 0 as a
+per-shard dead slot and keeps its own tail arena + dead-span list, so
+relocation traffic stays owner-local and the maintained entry tensors
+reshape losslessly to ``[D, E/D]`` stacked per-shard tables — the
+sharded solver's plan IS the reshaped global plan, no second
+allocator, no drift. Entry order within every node's region is
+unchanged, so a single-chip consumer of the same plan (the jax
+ladder rung below the sharded one) solves bit-identically to the
+unsharded layout.
 """
 
 from __future__ import annotations
@@ -127,6 +142,16 @@ def _pad_records(k: int) -> int:
     from .device_export import pad_record_count
 
     return pad_record_count(k)
+
+
+def shard_owner(node_ids, num_nodes: int, num_shards: int) -> np.ndarray:
+    """Owner shard per node id: contiguous range partition, so resource
+    subtrees laid out contiguously stay on one shard. The SAME
+    arithmetic the sharded solve kernel re-derives from iota on device
+    (parallel/sharded_solver.py re-exports this as ``node_owner``) —
+    one source of truth for who owns what."""
+    per = -(-num_nodes // max(num_shards, 1))
+    return np.minimum(np.asarray(node_ids) // per, num_shards - 1)
 
 
 _PLAN_APPLY = None
@@ -246,11 +271,20 @@ class SlotPlanState:
         #: rationing weight (churn-hot nodes get headroom first);
         #: persists across rebuilds like the high-water mark
         self._churn_ct = np.zeros(0, np.int64)  # kschedlint: host-only (host allocation bookkeeping)
-        #: first unassigned tail-pool position (relocation arena)
-        self._tail_next = 0
-        #: abandoned (start, cap) spans — relocation reuses them
-        #: best-fit before carving fresh tail, so moves don't leak
-        self._dead_spans: List[Tuple[int, int]] = []
+        #: first unassigned tail-pool position PER SHARD BLOCK
+        #: (relocation arena; one block covering the whole table in
+        #: the default single-shard layout)
+        self._tail_next = np.zeros(1, np.int64)  # kschedlint: host-only (host allocation bookkeeping)
+        #: abandoned (start, cap) spans per shard block — relocation
+        #: reuses them best-fit before carving fresh tail, so moves
+        #: don't leak
+        self._dead_spans: List[List[Tuple[int, int]]] = [[]]
+        #: sharded layout mode (enable_sharding): block count, equal
+        #: per-block extent (== entry_cap when unsharded), and the
+        #: node -> owner-shard map of the current layout
+        self._num_shards = 1
+        self.block_extent = 0
+        self._owner = np.zeros(0, np.int64)  # kschedlint: host-only (host allocation bookkeeping)
         # ---- dirty journal (for the device scatter) ------------------
         self._dirty_pos: set = set()
         self._dirty_inv: set = set()
@@ -290,6 +324,19 @@ class SlotPlanState:
         self.enabled = True
         if self.needs_rebuild:
             self._rebuild()
+
+    def enable_sharding(self, num_shards: int) -> None:
+        """Switch every FUTURE layout to the per-shard block form (see
+        the module docstring): block d holds the regions of exactly
+        the nodes shard d owns, with a per-block reserved dead slot
+        and a shard-local tail arena. Idempotent; a shard-count change
+        invalidates the layout (the sharded solver owns exactly one
+        mesh, so this fires once per process in practice)."""
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if num_shards != self._num_shards:
+            self._num_shards = num_shards
+            self.invalidate()
 
     # -- layout build ------------------------------------------------------
 
@@ -342,18 +389,8 @@ class SlotPlanState:
         # (not a pre-paid spare row for every node in the cluster — a
         # ~25%-of-table tax at production fill) is the designed path
         base = hwm.copy()
-        need = 1 + int(base.sum())
-        self.entry_cap = max(2 * m_cap, next_pow2(need))
-        # guarantee the relocation arena: when the pow2 lands so close
-        # to `need` that no real tail pool would remain, take the next
-        # bucket — at production fill the 2*m_cap term plus the
-        # dropped per-node spare row carry the floor comfortably
-        if self.entry_cap - need < max(64, self.entry_cap >> 4):
-            self.entry_cap = max(
-                2 * m_cap,
-                next_pow2(need + max(64, self.entry_cap >> 4)),
-            )
-        surplus = self.entry_cap - need
+        churn = self._churn_ct[:n_cap]
+        active = hwm > 0
         # slack headroom (module docstring): an active node wants a
         # flat +2 (the ±2 occupancy jump a task binding makes in one
         # round) plus 25% of its mark (drift room for the big
@@ -363,35 +400,100 @@ class SlotPlanState:
         # outranks a small id that recycles often. A tail-pool FLOOR
         # is reserved before any grant: whatever the grants leave (and
         # at least the floor) stays contiguous past the packed spans
-        # as the relocation arena.
-        grantable = max(surplus - max(64, self.entry_cap >> 4), 0)
-        churn = self._churn_ct[:n_cap]
-        active = hwm > 0
+        # as the relocation arena (per shard block in sharded mode).
         want = np.where(active, 2 + (hwm >> 2), 0)
-        slack = want
-        if int(want.sum()) > grantable:
-            order = np.argsort(-(churn * (hwm + 1)), kind="stable")
-            fits = np.cumsum(want[order]) <= grantable
+        D = self._num_shards
+        if D == 1:
+            owner = np.zeros(n_cap, np.int64)  # kschedlint: host-only (host layout build)
+            need = 1 + int(base.sum())
+            self.entry_cap = max(2 * m_cap, next_pow2(need))
+            # guarantee the relocation arena: when the pow2 lands so
+            # close to `need` that no real tail pool would remain, take
+            # the next bucket — at production fill the 2*m_cap term
+            # plus the dropped per-node spare row carry the floor
+            # comfortably
+            if self.entry_cap - need < max(64, self.entry_cap >> 4):
+                self.entry_cap = max(
+                    2 * m_cap,
+                    next_pow2(need + max(64, self.entry_cap >> 4)),
+                )
+            surplus = self.entry_cap - need
+            grantable = max(surplus - max(64, self.entry_cap >> 4), 0)
+            slack = want
+            if int(want.sum()) > grantable:
+                order = np.argsort(-(churn * (hwm + 1)), kind="stable")
+                fits = np.cumsum(want[order]) <= grantable
+                slack = np.zeros_like(want)
+                slack[order[fits]] = want[order[fits]]
+            self.block_extent = self.entry_cap
+        else:
+            # sharded layout: equal-extent per-shard blocks, each with
+            # its own reserved dead slot (local 0), packed regions, and
+            # tail arena. The block extent is sized for the DENSEST
+            # shard with full slack wants, floored at (2*m_cap)/D —
+            # the pow2-bucket common case the jaxpr contracts pin
+            # (sharded_entry_extent in parallel/sharded_solver.py)
+            owner = shard_owner(np.arange(n_cap), n_cap, D)
+            full = (base + want).astype(np.int64)  # kschedlint: host-only (host layout build)
+            shard_need = np.bincount(owner, weights=full, minlength=D).astype(np.int64) + 1  # kschedlint: host-only (host layout build)
+            max_need = int(shard_need.max())
+            Es = next_pow2(max_need)
+            if Es - max_need < max(64, Es >> 4):
+                Es = next_pow2(max_need + max(64, Es >> 4))
+            if (2 * m_cap) % D == 0:
+                Es = max(Es, (2 * m_cap) // D)
+            self.block_extent = Es
+            self.entry_cap = D * Es
+            base_sum = np.bincount(owner, weights=base.astype(np.float64), minlength=D).astype(np.int64)  # kschedlint: host-only (host layout build)
             slack = np.zeros_like(want)
-            slack[order[fits]] = want[order[fits]]
+            for d in range(D):
+                sel = np.flatnonzero(owner == d)
+                grantable = max(
+                    int(Es - 1 - base_sum[d] - max(64, Es >> 4)), 0
+                )
+                wd = want[sel]
+                if int(wd.sum()) <= grantable:
+                    slack[sel] = wd
+                else:
+                    order = np.argsort(
+                        -(churn[sel] * (hwm[sel] + 1)), kind="stable"
+                    )
+                    fits = np.cumsum(wd[order]) <= grantable
+                    slack[sel[order[fits]]] = wd[order[fits]]
         caps = base + slack
-        start = np.empty(n_cap, np.int64)  # kschedlint: host-only (host layout build)
-        start[0] = 1
-        np.cumsum(caps[:-1], out=start[1:])
-        start[1:] += 1
         E = self.entry_cap
+        Es = self.block_extent
+        self._owner = owner
+        start = np.empty(n_cap, np.int64)  # kschedlint: host-only (host layout build)
+        seg = np.zeros(E, np.int32)
+        isstart = np.zeros(E, bool)
+        tail0 = np.zeros(D, np.int64)  # kschedlint: host-only (host allocation bookkeeping)
+        for d in range(D):
+            sel = np.flatnonzero(owner == d) if D > 1 else np.arange(n_cap)
+            # each block's local position 0 is its reserved dead slot:
+            # its own one-row segment, never allocated (global position
+            # 0 keeps the historical reserved role on shard 0)
+            seg[d * Es] = d * Es
+            isstart[d * Es] = True
+            if len(sel) == 0:
+                # a shard can legitimately own zero nodes (D close to
+                # or above n_cap: ceil-division ranges leave trailing
+                # shards empty); its block is one dead slot + tail
+                tail0[d] = d * Es + 1
+                continue
+            cd = caps[sel]
+            sd = d * Es + 1 + np.concatenate(([0], np.cumsum(cd[:-1])))
+            start[sel] = sd
+            used_d = int(cd.sum())
+            seg[d * Es + 1 : d * Es + 1 + used_d] = np.repeat(sd, cd).astype(np.int32)
+            isstart[sd[cd > 0]] = True
+            tail0[d] = d * Es + 1 + used_d
         self.region_start = start.astype(np.int32)
         self.region_cap = caps.astype(np.int32)
         self.node_first = np.minimum(start, E - 1).astype(np.int32)
         self.node_last = np.minimum(start + caps - 1, E - 1).astype(np.int32)
         self.node_nonempty = caps > 0
-        seg = np.zeros(E, np.int32)
-        used_span = int(caps.sum())
-        seg[1 : 1 + used_span] = np.repeat(start, caps).astype(np.int32)
         self.seg_start = seg
-        isstart = np.zeros(E, bool)
-        isstart[0] = True
-        isstart[start[caps > 0]] = True
         self.is_start = isstart
         # entry placement: within a region, forward entries (slot
         # ascending) at the FRONT and backward entries (slot
@@ -447,8 +549,8 @@ class SlotPlanState:
         self._next_back = start + caps - counts_b - 1
         self._freed_f = {}
         self._freed_b = {}
-        self._tail_next = 1 + used_span
-        self._dead_spans = []
+        self._tail_next = tail0
+        self._dead_spans = [[] for _ in range(D)]
         self._dirty_pos.clear()
         self._dirty_inv.clear()
         self._dirty_seg.clear()
@@ -541,47 +643,52 @@ class SlotPlanState:
             heapq.heappush(self._freed_b.setdefault(node, []), -pos)
 
     def _return_span(self, start: int, cap: int) -> None:
-        """Give a span back to the arena, coalescing with adjacent
-        dead spans and with the tail frontier — relocation churn must
-        not shred the pool into unusable slivers (measured: ~90
-        abandoned 2-4 row fragments starving 6-row claims)."""
+        """Give a span back to its owner block's arena, coalescing with
+        adjacent dead spans and with the tail frontier — relocation
+        churn must not shred the pool into unusable slivers (measured:
+        ~90 abandoned 2-4 row fragments starving 6-row claims). A span
+        never straddles a block boundary by construction."""
+        d = start // self.block_extent if self.block_extent else 0
+        spans = self._dead_spans[d]
         merged = True
         while merged:
             merged = False
-            for i, (s0, c0) in enumerate(self._dead_spans):
+            for i, (s0, c0) in enumerate(spans):
                 if s0 + c0 == start:
                     start, cap = s0, c0 + cap
-                    self._dead_spans.pop(i)
+                    spans.pop(i)
                     merged = True
                     break
                 if start + cap == s0:
                     cap += c0
-                    self._dead_spans.pop(i)
+                    spans.pop(i)
                     merged = True
                     break
-        if start + cap == self._tail_next:
-            self._tail_next = start
+        if start + cap == self._tail_next[d]:
+            self._tail_next[d] = start
         else:
-            self._dead_spans.append((start, cap))
+            spans.append((start, cap))
 
-    def _claim_span(self, k: int) -> Optional[Tuple[int, int]]:
-        """A (start, cap) span of >= k rows for a relocated region:
-        best-fit from the dead-span list (split when the fit is loose
-        — the remainder stays claimable), else fresh tail. None when
-        neither fits."""
+    def _claim_span(self, k: int, shard: int = 0) -> Optional[Tuple[int, int]]:
+        """A (start, cap) span of >= k rows in `shard`'s block for a
+        relocated region: best-fit from the block's dead-span list
+        (split when the fit is loose — the remainder stays claimable),
+        else fresh tail. None when neither fits."""
+        spans = self._dead_spans[shard]
         best = -1
-        for i, (_s0, c0) in enumerate(self._dead_spans):
-            if c0 >= k and (best < 0 or c0 < self._dead_spans[best][1]):
+        for i, (_s0, c0) in enumerate(spans):
+            if c0 >= k and (best < 0 or c0 < spans[best][1]):
                 best = i
         if best >= 0:
-            s0, c0 = self._dead_spans.pop(best)
+            s0, c0 = spans.pop(best)
             if c0 - k >= 8:
-                self._dead_spans.append((s0 + k, c0 - k))
+                spans.append((s0 + k, c0 - k))
                 return (s0, k)
             return (s0, c0)
-        if self._tail_next + k <= self.entry_cap:
-            s0 = self._tail_next
-            self._tail_next += k
+        limit = (shard + 1) * self.block_extent
+        if self._tail_next[shard] + k <= limit:
+            s0 = int(self._tail_next[shard])
+            self._tail_next[shard] += k
             return (s0, k)
         return None
 
@@ -597,6 +704,7 @@ class SlotPlanState:
         old_start = int(self.region_start[node])
         old_cap = int(self.region_cap[node])
         occ = int(self._occ[node])
+        shard = int(self._owner[node]) if len(self._owner) > node else 0
         # 1.25x growth: big aggregator regions dominate pool traffic,
         # and doubling a 70-row region for a +1 record wastes half the
         # arena; a quarter-step still amortizes the move count
@@ -611,20 +719,20 @@ class SlotPlanState:
             # pool health so a poisoned type record (types can mix
             # giants with minnows) can't let a few fresh claims drain
             # the arena.
-            pool_left = (self.entry_cap - self._tail_next) + sum(
-                c for _, c in self._dead_spans
-            )
+            pool_left = int(
+                (shard + 1) * self.block_extent - self._tail_next[shard]
+            ) + sum(c for _, c in self._dead_spans[shard])
             rec = max(
                 self._type_hwm.get(int(self.state.node_type[node]), 0),
                 int(self._deg_hwm[node]),
             )
             hint = rec + max(2, rec >> 3)  # drift margin atop the record
             want = max(want, min(hint, max(pool_left >> 1, 8)))
-        placed = self._claim_span(want)
+        placed = self._claim_span(want, shard)
         if placed is None:
             # doubling doesn't fit — a minimal region still beats a
             # full layout rebuild
-            placed = self._claim_span(max(occ + 2, 4))
+            placed = self._claim_span(max(occ + 2, 4), shard)
         if placed is None:
             return False
         new_start, new_cap = placed
@@ -806,6 +914,87 @@ class SlotPlanState:
         self.clear_pending()
         return row_rec, inv_rec, seg_rec, node_rec
 
+    def drain_records_sharded(
+        self,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Per-shard routed form of ``drain_records`` (requires sharded
+        layout mode): dirty plan rows and relocated segment statics are
+        grouped by OWNER SHARD (position // block_extent) with
+        block-local positions, stacked ``[D, K, cols]`` and padded to
+        one shared pow2 record bucket per stream — a shard with fewer
+        (or zero) records pads idempotently by rewriting its own
+        reserved dead local slot 0 (rows: zeros; segment statics: the
+        dead slot's permanent meta). The inv-order and node-boundary
+        records stay in the global replicated form (those tensors are
+        replicated on device by the partition rules). Returns
+        ``(row [D, Kp, 5], seg [D, Ks, 3], inv [Ki, 2], node [Kn, 4])``
+        and clears the journal."""
+        D = self._num_shards
+        Es = self.block_extent
+        pos = np.sort(np.fromiter(self._dirty_pos, np.int64, len(self._dirty_pos)))  # kschedlint: host-only (host record packing)
+        segs = np.sort(np.fromiter(self._dirty_seg, np.int64, len(self._dirty_seg)))  # kschedlint: host-only (host record packing)
+        ents = np.sort(np.fromiter(self._dirty_inv, np.int32, len(self._dirty_inv)))
+        nids = np.sort(np.fromiter(self._dirty_node, np.int32, len(self._dirty_node)))
+
+        def route(idx, cols, fill):
+            """[D, K, cols] per-shard records from global positions."""
+            owner = idx // Es
+            counts = np.bincount(owner, minlength=D)
+            k = _pad_records(int(counts.max()) if len(idx) else 0)
+            rec = np.zeros((D, k, cols), np.int32)
+            for d in range(D):
+                rec[d] = fill(d)  # idempotent dead-slot pad, whole block
+                mine = idx[owner == d]
+                kd = len(mine)
+                if kd:
+                    rec[d, :kd, 0] = (mine - d * Es).astype(np.int32)
+                    rec[d, :kd, 1:] = self._row_values(mine, cols)
+                    rec[d, kd:] = rec[d, 0]
+            return rec
+
+        row_rec = route(
+            pos, PLAN_RECORD_COLS,
+            lambda d: np.zeros(PLAN_RECORD_COLS, np.int32),
+        )
+        seg_rec = route(
+            segs, SEG_RECORD_COLS,
+            lambda d: np.array([0, d * Es, 1], np.int32),
+        )
+        ki, kn = len(ents), len(nids)
+        inv_rec = np.zeros((_pad_records(ki), INV_RECORD_COLS), np.int32)
+        if ki:
+            inv_rec[:ki, 0] = ents
+            inv_rec[:ki, 1] = self.inv_order[ents]
+            inv_rec[ki:] = inv_rec[0]
+        else:
+            inv_rec[:, 1] = self.inv_order[0]
+        node_rec = np.zeros((_pad_records(kn), NODE_RECORD_COLS), np.int32)
+        if kn:
+            node_rec[:kn, 0] = nids
+            node_rec[:kn, 1] = self.node_first[nids]
+            node_rec[:kn, 2] = self.node_last[nids]
+            node_rec[:kn, 3] = self.node_nonempty[nids]
+            node_rec[kn:] = node_rec[0]
+        else:
+            node_rec[:, 1] = self.node_first[0]
+            node_rec[:, 2] = self.node_last[0]
+            node_rec[:, 3] = self.node_nonempty[0]
+        self.clear_pending()
+        return row_rec, seg_rec, inv_rec, node_rec
+
+    def _row_values(self, idx: np.ndarray, cols: int) -> np.ndarray:
+        """Value columns for routed records at global positions `idx`
+        (row records carry the four plan-row values, segment records
+        the (seg_start, is_start) pair)."""
+        if cols == PLAN_RECORD_COLS:
+            return np.stack(
+                [self.p_arc[idx], self.p_sign[idx], self.p_src[idx], self.p_dst[idx]],
+                axis=1,
+            )
+        return np.stack(
+            [self.seg_start[idx], self.is_start[idx].astype(np.int32)], axis=1
+        )
+
     def clear_pending(self) -> None:
         self._dirty_pos.clear()
         self._dirty_inv.clear()
@@ -948,7 +1137,10 @@ class SlotPlanState:
         assert (self._deg_hwm[: st.n_cap] >= occ).all(), (
             "degree high-water mark fell below live occupancy"
         )
-        assert self._tail_next <= self.entry_cap, "tail pool overran the table"
+        block_limits = (np.arange(self._num_shards, dtype=np.int64) + 1) * self.block_extent  # kschedlint: host-only (test-only invariant check)
+        assert (self._tail_next <= block_limits).all(), (
+            "a tail pool overran its shard block"
+        )
         # the load-bearing fwd-front/bwd-back split within every region
         fpos = np.flatnonzero(self.p_sign == 1).astype(np.int64)  # kschedlint: host-only (test-only invariant check)
         bpos = np.flatnonzero(self.p_sign == -1).astype(np.int64)  # kschedlint: host-only (test-only invariant check)
@@ -968,10 +1160,19 @@ class SlotPlanState:
         lo = starts[held][order]
         hi = lo + caps64[held][order]
         if lo.size:
-            assert lo[0] >= 1 and hi[-1] <= self._tail_next, (
+            assert (hi[:-1] <= lo[1:]).all(), "regions overlap"
+            # every held region lives inside its OWNER's block, past
+            # the block's reserved dead slot and under its tail
+            # frontier (one block == the whole table when unsharded)
+            own = self._owner[np.flatnonzero(held)]
+            s_h = starts[held]
+            e_h = s_h + caps64[held]
+            assert (s_h >= own * self.block_extent + 1).all(), (
+                "a region precedes its block's reserved dead slot"
+            )
+            assert (e_h <= self._tail_next[own]).all(), (
                 "a region lies outside the packed/tail extent"
             )
-            assert (hi[:-1] <= lo[1:]).all(), "regions overlap"
         for node in np.flatnonzero(held):
             assert int(self.node_first[node]) == int(starts[node])
             assert int(self.node_last[node]) == int(starts[node] + caps64[node] - 1)

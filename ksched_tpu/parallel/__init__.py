@@ -1,4 +1,14 @@
-from .sharded_solver import ShardedJaxSolver, ShardedPlan, build_sharded_plan, make_sharded_solver
+from .sharded_solver import (
+    ShardedJaxSolver,
+    ShardedPlan,
+    build_sharded_plan,
+    make_sharded_slot_solver,
+    make_sharded_solver,
+    scan_csr_fits_hbm,
+    sharded_fits_hbm,
+    sharded_plan_apply_fn,
+    sharded_plan_fingerprint_fn,
+)
 from .sharded_transport import ShardedLayeredSolver, sharded_transport_solve
 from .whatif import (
     ScenarioBatchResult,
@@ -14,6 +24,11 @@ __all__ = [
     "ShardedPlan",
     "build_sharded_plan",
     "make_sharded_solver",
+    "make_sharded_slot_solver",
+    "scan_csr_fits_hbm",
+    "sharded_fits_hbm",
+    "sharded_plan_apply_fn",
+    "sharded_plan_fingerprint_fn",
     "ScenarioBatchResult",
     "WhatIfSolver",
     "drain_scenarios",

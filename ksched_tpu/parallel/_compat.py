@@ -7,7 +7,16 @@ for `lax.while_loop` — there the solvers pass `check_rep=False` (their
 psum/pmin combines are rep-correct by construction: owner-masked dense
 vectors) and pcast-style varying marks are unnecessary. Both sharded
 modules import from here so the two detections can never diverge.
+
+The fallback is no longer silent: the first sharded program built on
+the experimental path emits a one-time RuntimeWarning naming the jax
+version and the `check_rep=False` consequence, so a production log can
+distinguish "native shard_map with replication checking" from "legacy
+fallback trusting the solvers' own rep discipline" without reading
+this file.
 """
+
+import warnings
 
 try:
     from jax import shard_map
@@ -19,3 +28,29 @@ except ImportError:
 
     SHARD_MAP_KWARGS = {"check_rep": False}
     IS_EXPERIMENTAL = True
+
+_WARNED = False
+
+
+def warn_if_fallback() -> None:
+    """One-time RuntimeWarning when running on the experimental
+    shard_map fallback: replication checking is OFF (check_rep=False),
+    so a rep-incorrect collective would corrupt silently instead of
+    failing to trace — the sharded parity suites are the guard. Called
+    by every sharded solver factory; a no-op on jax >= 0.6."""
+    global _WARNED
+    if not IS_EXPERIMENTAL or _WARNED:
+        return
+    _WARNED = True
+    import jax
+
+    warnings.warn(
+        f"jax {jax.__version__} has no jax.shard_map; sharded solvers "
+        "fall back to jax.experimental.shard_map with check_rep=False "
+        "(replication checking disabled — collective correctness rests "
+        "on the owner-masked psum discipline and the bit-parity "
+        "suites). Upgrade to jax >= 0.6 for native varying-ness "
+        "tracking.",
+        RuntimeWarning,
+        stacklevel=3,
+    )

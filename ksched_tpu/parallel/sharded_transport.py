@@ -52,8 +52,17 @@ AXIS = "x"
 from ._compat import (  # noqa: E402  (see _compat.py for the version story)
     IS_EXPERIMENTAL as _SHARD_MAP_EXPERIMENTAL,
     SHARD_MAP_KWARGS as _SHARD_MAP_KWARGS,
-    shard_map as _shard_map,
+    shard_map as _shard_map_native,
+    warn_if_fallback as _warn_if_fallback,
 )
+
+
+def _shard_map(*args, **kwargs):
+    # the one-time fallback RuntimeWarning fires at program-build time
+    # (not import time), so logs attribute it to the process that
+    # actually ran a sharded program
+    _warn_if_fallback()
+    return _shard_map_native(*args, **kwargs)
 
 
 def _pcast_varying(x):

@@ -272,8 +272,16 @@ def apply_device_corruption(resident, spec: Dict) -> None:
     buf = getattr(resident, attr, None)
     if buf is None:
         return  # mirror not built for that family yet: flip has no target
-    idx = int(spec["index"]) % int(buf.shape[0])
-    new = corrupt_fn()(buf, jnp.int32(idx), jnp.int32(int(spec["bit"]) % 31))
+    shape = buf.shape
+    if len(shape) > 1:  # sharded [D, Es] plan tensor: flip ONE element
+        idx = int(spec["index"]) % int(buf.size)
+        flat = buf.reshape(-1)
+        new = corrupt_fn()(
+            flat, jnp.int32(idx), jnp.int32(int(spec["bit"]) % 31)
+        ).reshape(shape)
+    else:
+        idx = int(spec["index"]) % int(shape[0])
+        new = corrupt_fn()(buf, jnp.int32(idx), jnp.int32(int(spec["bit"]) % 31))
     setattr(resident, attr, new)
 
 
@@ -403,12 +411,10 @@ class StateAuditor:
                     diverged.append(name)
         if self._plan_in_sync():
             plan = r.state.plan
-            dev = device_fingerprints(
-                tuple(getattr(r, "d_" + a) for a in (
-                    "p_arc", "p_sign", "p_src", "p_dst", "inv",
-                    "seg", "isstart", "first", "last", "nonempty",
-                ))
-            )
+            # the mirror owns the program choice: the sharded mirror
+            # psums per-shard partials with global-index weights, so
+            # both modes compare against the SAME host twins
+            dev = r.plan_fingerprints()
             key = (plan.layout_gen, plan.value_version)
             if self._fp_plan_cache is None or self._fp_plan_cache[0] != key:
                 self._fp_plan_cache = (
@@ -456,11 +462,10 @@ class StateAuditor:
                 dev = getattr(r, attr.get(name, "d_" + name))
             else:
                 dev = getattr(r, "d_" + name)
-            out.append(
-                bounded_diff(
-                    name, np.asarray(dev).astype(np.int32), want.astype(np.int32)
-                )
-            )
+            got = np.asarray(dev).astype(np.int32)
+            if got.ndim > 1:  # sharded [D, Es] stacking of the [E] tensor
+                got = got.reshape(-1)
+            out.append(bounded_diff(name, got, want.astype(np.int32)))
         return out
 
     def _note_event(self, diverged: List[str]) -> None:

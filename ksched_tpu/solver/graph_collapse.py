@@ -656,29 +656,47 @@ def try_collapse(problem) -> Tuple[Optional[GraphCollapse], str]:
 
 
 class AutoSolver(FlowSolver):
-    """The automatic policy-dispatch seam, now a three-rung ladder:
-    dense transport when the graph is collapsible, the VMEM-resident
-    Pallas megakernel (solver/mega_solver.py) when a general graph
-    fits the kernel's VMEM tiling budget, the scan-based CSR backend
-    as the guaranteed-correct fallback. Drop-in FlowSolver
-    (PlacementSolver/FlowScheduler-compatible); `last_path` ("dense" |
-    "mega" | "csr") / `last_refusal` / `last_mega_refusal` expose which
-    way each solve went and why.
+    """The automatic policy-dispatch seam, now a FOUR-rung ladder by
+    graph size: dense transport when the graph is collapsible, the
+    VMEM-resident Pallas megakernel (solver/mega_solver.py) when a
+    general graph fits the kernel's VMEM tiling budget, the scan-based
+    CSR backend while its HBM working set fits one chip, and the
+    SHARDED multi-chip backend (parallel/sharded_solver.py) beyond
+    that. Drop-in FlowSolver (PlacementSolver/FlowScheduler-
+    compatible); `last_path` ("dense" | "mega" | "csr" | "sharded") /
+    `last_refusal` / `last_mega_refusal` expose which way each solve
+    went and why.
 
-    `mega` is optional: without one the ladder is the historical
-    dense -> CSR dispatch. The cost model behind the mega rung is the
-    kernel's live-set arithmetic (ops/mcmf_pallas.py mega_fits_vmem):
-    escalation to scan-CSR happens exactly when the padded entry
-    tables exceed the VMEM budget, the scaled costs overflow the
-    kernel's int32 exactness contract, or the graph is degenerate in
-    a way the kernel's segment space cannot represent — every
-    refusal reason rides `MegaSolver.fits()`/`last_mega_refusal`."""
+    `mega` and `sharded` are optional: without them the ladder is the
+    historical dense -> CSR dispatch. The cost model behind the mega
+    rung is the kernel's live-set arithmetic (ops/mcmf_pallas.py
+    mega_fits_vmem); the sharded rung mirrors it one level up the
+    memory hierarchy (`scan_csr_fits_hbm` / `sharded_fits_hbm`,
+    parallel/sharded_solver.py): escalation to the sharded rung
+    happens exactly when the scan-CSR live set outgrows the per-chip
+    HBM working-set budget AND the per-shard slice fits it — a graph
+    too big even per-shard falls back to scan-CSR, the guaranteed-
+    correct (if memory-risky) total rung. The budget resolves from
+    `hbm_budget_bytes`, else the KSCHED_HBM_BUDGET env var, else
+    DEFAULT_HBM_BUDGET_BYTES (docs/sharding.md derives it)."""
 
     def __init__(self, csr_backend: FlowSolver,
                  alpha: int = 8, max_supersteps: int = 1 << 17,
-                 mega: Optional[FlowSolver] = None):
+                 mega: Optional[FlowSolver] = None,
+                 sharded=None,
+                 hbm_budget_bytes: Optional[int] = None):
         self.csr = csr_backend
         self.mega = mega
+        #: sharded rung: a FlowSolver, or a zero-arg factory resolved
+        #: lazily on the first escalation (mesh construction and
+        #: shard_map compiles cost nothing until a graph needs them)
+        self._sharded = sharded
+        if hbm_budget_bytes is None:
+            import os
+
+            env = os.environ.get("KSCHED_HBM_BUDGET")
+            hbm_budget_bytes = int(env) if env else None
+        self.hbm_budget_bytes = hbm_budget_bytes
         self.alpha = alpha
         self.max_supersteps = max_supersteps
         self.last_path = ""
@@ -689,10 +707,49 @@ class AutoSolver(FlowSolver):
         #: solve (obs/soltel.py); solve_traced publishes it
         self.last_telemetry = None
 
+    @property
+    def sharded(self):
+        """The sharded rung, resolving a lazy factory on first use."""
+        s = self._sharded
+        if s is not None and not isinstance(s, FlowSolver) and callable(s):
+            s = s()
+            if not isinstance(s, FlowSolver):
+                raise TypeError(
+                    f"sharded factory returned {type(s).__name__}"
+                )
+            self._sharded = s
+        return s
+
     def reset(self) -> None:
         self.csr.reset()
         if self.mega is not None:
             self.mega.reset()
+        if isinstance(self._sharded, FlowSolver):
+            self._sharded.reset()
+
+    def _escalates_to_sharded(self, problem) -> bool:
+        """The HBM fitting gate: True when the single-chip scan-CSR
+        working set exceeds the per-chip budget AND the per-shard
+        slice fits it (parallel/sharded_solver.py live-set
+        arithmetic, mirroring mega_fits_vmem one memory level up)."""
+        if self._sharded is None:
+            return False
+        from ..parallel.sharded_solver import (
+            DEFAULT_HBM_BUDGET_BYTES,
+            scan_csr_fits_hbm,
+            sharded_fits_hbm,
+        )
+
+        budget = self.hbm_budget_bytes
+        if budget is None:
+            budget = DEFAULT_HBM_BUDGET_BYTES
+        n_cap = problem.num_nodes
+        m_cap = len(problem.src)
+        if scan_csr_fits_hbm(n_cap, m_cap, budget):
+            return False
+        sharded = self.sharded  # resolve the factory: we need its mesh
+        num_shards = getattr(sharded, "num_shards", 1)
+        return sharded_fits_hbm(n_cap, m_cap, num_shards, budget)
 
     def solve(self, problem) -> FlowResult:
         collapse, reason = try_collapse(problem)
@@ -707,11 +764,20 @@ class AutoSolver(FlowSolver):
                 )
                 self.last_telemetry = getattr(mega, "last_telemetry", None)
                 return res
-            self.last_path, self.last_refusal = "csr", reason
             self.last_mega_refusal = (
                 getattr(mega, "last_refusal", "") if mega is not None
                 else "no megakernel attached"
             )
+            if self._escalates_to_sharded(problem):
+                sharded = self.sharded
+                self.last_path, self.last_refusal = "sharded", reason
+                res = sharded.solve(problem)
+                self.last_supersteps = getattr(
+                    sharded, "last_supersteps", res.iterations
+                )
+                self.last_telemetry = getattr(sharded, "last_telemetry", None)
+                return res
+            self.last_path, self.last_refusal = "csr", reason
             res = self.csr.solve(problem)
             ss = getattr(self.csr, "last_supersteps", None)
             self.last_supersteps = (

@@ -728,6 +728,20 @@ class JaxSolver(FlowSolver):
             # otherwise the plan's own cached full upload (re-shipped
             # only when its value_version moved).
             d_plan = getattr(problem, "d_plan", None)
+            if d_plan is not None and getattr(d_plan[0], "ndim", 1) == 2:
+                # sharded-mode mirror: the entry tensors are [D, Es]
+                # stacked per-shard tables. The stacking is a lossless
+                # reshape of the global layout (graph/slot_plan.py
+                # sharded block form), so flattening them recovers the
+                # exact single-chip tensors — this is the degradation
+                # ladder's jax rung (and AutoSolver's too-big-even-
+                # per-shard CSR fallback) consuming a sharded mirror.
+                # On a real mesh the reshape gathers the shards; a
+                # degraded round may pay that once.
+                d_plan = tuple(
+                    x.reshape(-1) if getattr(x, "ndim", 1) == 2 else x
+                    for x in d_plan
+                )
             plan_dev = d_plan if d_plan is not None else plan_state.device_args()
         else:
             plan_dev = self._plan_for(

@@ -14,8 +14,8 @@ from .base import FlowSolver
 
 
 def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> FlowSolver:
-    """name: "native" | "jax" | "ell" | "mega" | "ref" | "layered" |
-    "auto". With fallback=True a failed native build degrades to the
+    """name: "native" | "jax" | "ell" | "mega" | "sharded" | "ref" |
+    "layered" | "auto". With fallback=True a failed native build degrades to the
     JAX solver with a RuntimeWarning (capturable by callers/tests via
     warnings.catch_warnings, unlike the stderr print it replaced)."""
     if name == "native":
@@ -58,6 +58,20 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
             warm_start=warm_start,
             fallback=JaxSolver(warm_start=warm_start),
         )
+    if name == "sharded":
+        # the multi-chip slot-stable backend over the full device mesh
+        # (parallel/sharded_solver.py); under AutoSolver ("auto") it is
+        # the fourth rung behind the HBM fitting gate — selecting it
+        # directly forces every general-graph solve onto the mesh
+        import numpy as _np
+        import jax
+        from jax.sharding import Mesh
+
+        from ..parallel.sharded_solver import ShardedJaxSolver
+
+        return ShardedJaxSolver(
+            Mesh(_np.array(jax.devices()), ("x",)), warm_start=warm_start
+        )
     if name == "ref":
         from .cpu_ref import ReferenceSolver
 
@@ -70,11 +84,15 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
         # the policy-dispatch seam (docs/solver_coverage.md): dense
         # transport whenever the graph audits as collapsible, then the
         # megakernel for general graphs inside its VMEM budget, the
-        # scan-based CSR backend as the total fallback — per solve,
-        # automatically. The mega rung is attached only when Pallas
-        # dispatch is live (TPU backend, or a forced "on"/"interpret"
-        # mode): interpreting the kernel on CPU would be strictly
-        # slower than the XLA scan path it replaces.
+        # scan-based CSR backend while its HBM working set fits one
+        # chip, the sharded multi-chip backend beyond that — per
+        # solve, automatically. The mega rung is attached only when
+        # Pallas dispatch is live (TPU backend, or a forced
+        # "on"/"interpret" mode): interpreting the kernel on CPU would
+        # be strictly slower than the XLA scan path it replaces. The
+        # sharded rung is attached (lazily — no mesh or shard_map
+        # compile until the fitting gate escalates) whenever the
+        # process sees more than one device.
         from ..ops import resolve_pallas
         from .graph_collapse import AutoSolver
 
@@ -83,11 +101,28 @@ def make_backend(name: str, warm_start: bool = True, fallback: bool = True) -> F
             from .mega_solver import MegaSolver
 
             mega = MegaSolver(warm_start=warm_start)
+        sharded = None
+        import jax
+
+        if len(jax.devices()) > 1:
+            def _make_sharded():
+                import numpy as _np
+                from jax.sharding import Mesh
+
+                from ..parallel.sharded_solver import ShardedJaxSolver
+
+                devs = _np.array(jax.devices())
+                return ShardedJaxSolver(
+                    Mesh(devs, ("x",)), warm_start=warm_start
+                )
+
+            sharded = _make_sharded
         return AutoSolver(
             make_backend("native", warm_start=warm_start, fallback=fallback),
             mega=mega,
+            sharded=sharded,
         )
     raise ValueError(
         f"unknown backend {name!r}; want native | jax | ell | mega | "
-        "ref | layered | auto"
+        "sharded | ref | layered | auto"
     )

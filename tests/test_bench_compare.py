@@ -152,6 +152,38 @@ def test_entry_marks_fallback():
     assert e["accelerator_unreachable"] and e["platform"] == "cpu-fallback"
 
 
+def test_mesh_shape_is_part_of_the_series_key():
+    """A 2-dev CPU reading must never baseline (or gate) an 8-dev
+    series: entries with different mesh_devices are different series,
+    so a fast small-mesh run followed by a slower big-mesh run is NOT
+    a regression (and vice versa can't mask one)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = d + "/traj.jsonl"
+        _write(p, [
+            _entry("sharded", 10.0, mesh_devices=2),
+            _entry("sharded", 50.0, mesh_devices=8),  # not a regression
+        ])
+        assert _gate(p).returncode == 0
+        _write(p, [
+            _entry("sharded", 10.0, mesh_devices=8),
+            _entry("sharded", 50.0, mesh_devices=8),  # IS a regression
+        ])
+        r = _gate(p)
+        assert r.returncode == 1 and "8dev" in r.stdout
+
+
+def test_entry_from_record_lifts_mesh_devices():
+    rec = {
+        "metric": "p50 ... backend=sharded/cpu",
+        "value": 5.0,
+        "detail": {"mesh_devices": 8},
+    }
+    e = entry_from_record(rec, config="gtrace100k")
+    assert e["mesh_devices"] == 8
+
+
 def test_checked_in_trajectory_is_wellformed_and_gates_clean():
     import os
 

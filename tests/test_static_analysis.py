@@ -421,12 +421,13 @@ def test_delta_apply_scatters_and_is_32bit():
 
 
 def test_delta_apply_exemption_is_scoped():
-    """The exemptions cover EXACTLY TWO programs (the problem-delta
-    apply and the slot-stable plan apply, both once-per-round
-    maintenance outside any solve): every registered solver backend
-    still traces zero scatters (the existing per-backend sweep
-    re-asserted here so the exemption tests and the zero-scatter rule
-    can never pass for contradictory reasons)."""
+    """The exemptions cover EXACTLY THREE programs (the problem-delta
+    apply, the slot-stable plan apply, and the per-shard routed
+    sharded plan apply — all once-per-round maintenance outside any
+    solve): every registered solver backend still traces zero scatters
+    (the existing per-backend sweep re-asserted here so the exemption
+    tests and the zero-scatter rule can never pass for contradictory
+    reasons)."""
     for backend in jc.REGISTERED_BACKENDS:
         report = jc.backend_report(backend, 20, 100)
         assert report.ok_scatter, (backend, report.scatter_eqns)
@@ -544,6 +545,112 @@ def test_refit_slot_stable_combo_is_scatter_free():
     assert report.ok_scatter, report.scatter_eqns
     assert report.ok_64bit, report.violations_64bit
     assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.trace_jax_warmp(20, 100))
+
+
+# ---------------------------------------------------------------------------
+# Slot-stable SHARDED solve + per-shard plan apply (parallel/, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_slot_trace_no_64bit_no_scatter():
+    """The slot-stable sharded solve stays a SOLVE program: zero
+    scatters (cross-shard combines are psum/pmin/pmax of owner-masked
+    vectors), everything int32."""
+    for warm in (False, True):
+        closed = jc.trace_sharded_slot(20, 100, num_devices=2, use_warm_p=warm)
+        report = jc.check_jaxpr("sharded_slot", closed)
+        assert report.ok_scatter, (warm, report.scatter_eqns)
+        assert report.ok_64bit, (warm, report.violations_64bit)
+        assert report.num_eqns > 0
+
+
+def test_sharded_slot_shard_count_bucket_stable():
+    """One executable per (pow2 shape bucket, shard count): raw sizes
+    within a bucket trace byte-identical programs at 2, 4, AND 8
+    devices, and different shard counts trace DIFFERENT programs (each
+    mesh size is its own bucket — the bench_compare series key mirrors
+    this with mesh_devices)."""
+    per_d = {}
+    for d in (2, 4, 8):
+        ha = jc.jaxpr_hash(jc.trace_sharded_slot(20, 100, num_devices=d))
+        hb = jc.jaxpr_hash(jc.trace_sharded_slot(24, 110, num_devices=d))
+        assert ha == hb, f"{d}-dev sharded solve leaks a raw size (recompile hazard)"
+        per_d[d] = ha
+    assert len(set(per_d.values())) == 3, (
+        "different shard counts must trace different programs "
+        f"(collision: {per_d})"
+    )
+
+
+def test_sharded_slot_warm_variant_is_distinct():
+    assert jc.jaxpr_hash(jc.trace_sharded_slot(20, 100)) != jc.jaxpr_hash(
+        jc.trace_sharded_slot(20, 100, use_warm_p=True)
+    )
+
+
+def test_sharded_slot_telemetry_off_is_default_and_on_differs():
+    off = jc.jaxpr_hash(jc.trace_sharded_slot(20, 100, telemetry_cap=0))
+    on = jc.jaxpr_hash(jc.trace_sharded_slot(20, 100, telemetry_cap=512))
+    assert off == jc.jaxpr_hash(jc.trace_sharded_slot(20, 100))
+    assert on != off
+    report = jc.check_jaxpr(
+        "sharded_slot+tel", jc.trace_sharded_slot(20, 100, telemetry_cap=512)
+    )
+    assert report.ok_scatter and report.ok_64bit
+
+
+def test_sharded_superstep_ici_budget():
+    """The documented ICI shape of a sharded superstep: exactly three
+    psum families ride the solve loop (the [N] excess combine, the [M]
+    arc-delta combine, the [N] potential combine), plus the segment
+    pmin (tighten sweeps) and the phase-boundary saturate pmax — and
+    nothing else (no all_gather / all_to_all / ppermute anywhere).
+    Telemetry adds its scalar counter psums only when ON."""
+    counts = jc.count_superstep_collectives(jc.trace_sharded_slot(20, 100))
+    assert counts.get("psum", 0) == 3, counts
+    assert counts.get("pmin", 0) == 1, counts  # tighten sweep (prologue loop)
+    assert counts.get("pmax", 0) == 2, counts  # sat_full's fwd/bwd combines
+    assert not counts.get("all_gather") and not counts.get("all_to_all")
+    assert not counts.get("ppermute")
+    on = jc.count_superstep_collectives(
+        jc.trace_sharded_slot(20, 100, telemetry_cap=512)
+    )
+    assert on.get("psum", 0) > counts["psum"]  # the 4 counter psums
+
+
+def test_sharded_plan_apply_scatters_and_is_32bit():
+    """The per-shard routed plan apply is the THIRD (and last) scoped
+    scatter exemption: really scatters, all 32-bit, and contains NO
+    collectives — the owner routing happened on host, so the program
+    is embarrassingly parallel across shards."""
+    closed = jc.trace_sharded_plan_apply(5, 3)
+    report = jc.check_jaxpr("sharded_plan_apply", closed)
+    assert report.scatter_eqns, (
+        "the sharded plan-apply trace contains no scatters — the "
+        "scoped exemption is vacuous"
+    )
+    assert report.ok_64bit, report.violations_64bit
+    assert jc.count_collectives(closed) == {}
+
+
+def test_sharded_plan_apply_pow2_record_bucket_hash_stable():
+    assert jc.jaxpr_hash(jc.trace_sharded_plan_apply(3, 2)) == jc.jaxpr_hash(
+        jc.trace_sharded_plan_apply(7, 5)
+    )
+    assert jc.jaxpr_hash(jc.trace_sharded_plan_apply(3, 2)) != jc.jaxpr_hash(
+        jc.trace_sharded_plan_apply(100, 2)
+    )
+
+
+def test_sharded_plan_fingerprint_scatter_free_psummed():
+    """The sharded audit program: scatter-free, 32-bit, and its ONLY
+    collectives are the per-tensor psums that fold per-shard partials
+    into the one comparable checksum (6 entry-shaped tensors)."""
+    closed = jc.trace_sharded_plan_fingerprint()
+    report = jc.check_jaxpr("sharded_plan_fp", closed)
+    assert report.ok_scatter, report.scatter_eqns
+    assert report.ok_64bit, report.violations_64bit
+    assert jc.count_collectives(closed).get("psum", 0) == 6
 
 
 # ---------------------------------------------------------------------------

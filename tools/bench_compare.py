@@ -20,7 +20,9 @@ a small absolute slack, since healthy values sit near ~10 where ±
 a-few is quantization, not regression.
 
 Cross-platform readings don't gate each other: entries compare only
-within the same (config, platform) series, and entries stamped
+within the same (config, platform, mesh_devices) series — the mesh
+shape (device count) is part of the series identity, so a 2-dev CPU
+sharded reading never baselines an 8-dev one — and entries stamped
 `accelerator_unreachable` are never used as a baseline for device
 readings.
 
@@ -85,6 +87,12 @@ def entry_from_record(record: dict, config: Optional[str] = None,
     for key in ("supersteps_p50", "supersteps_p99", "supersteps_max"):
         if key in detail:
             entry[key] = detail[key]
+    # mesh shape: multi-chip readings are their own series — a 2-dev
+    # CPU reading must never baseline (or gate) an 8-dev one, the same
+    # isolation rule as cross-platform entries
+    mesh = detail.get("mesh_devices", record.get("mesh_devices"))
+    if mesh is not None:
+        entry["mesh_devices"] = int(mesh)
     # the churn (round-pipeline) config: lift the arm comparison into
     # the series so the ratchet history shows WHERE the p50 comes from
     arms = detail.get("arms")
@@ -159,7 +167,12 @@ def append_cmd(args) -> int:
 
 
 def _series_key(entry: dict):
-    return (entry.get("config"), entry.get("platform"))
+    # mesh shape (device count) is part of the series identity: sharded
+    # readings taken on different mesh sizes are different experiments
+    # (single-chip entries carry no mesh field and keep their series)
+    return (
+        entry.get("config"), entry.get("platform"), entry.get("mesh_devices")
+    )
 
 
 def gate_cmd(args) -> int:
@@ -173,7 +186,13 @@ def gate_cmd(args) -> int:
         series.setdefault(_series_key(e), []).append(e)
     failures = []
     checked = 0
-    for (config, platform), es in sorted(series.items()):
+    for (config, platform, mesh), es in sorted(
+        series.items(),
+        key=lambda kv: (
+            str(kv[0][0]), str(kv[0][1]),
+            -1 if kv[0][2] is None else int(kv[0][2]),
+        ),
+    ):
         if len(es) < 2:
             continue
         prev, last = es[-2], es[-1]
@@ -186,7 +205,9 @@ def gate_cmd(args) -> int:
         checked += 1
         p_prev, p_last = float(prev["p50_ms"]), float(last["p50_ms"])
         ratio = (p_last - p_prev) / max(p_prev, 1e-9)
-        tag = f"{config} [{platform}]"
+        tag = f"{config} [{platform}]" + (
+            f" [{mesh}dev]" if mesh is not None else ""
+        )
         verdict = "OK" if ratio <= args.tolerance else "REGRESSED"
         print(
             f"{tag:<40} p50 {p_prev:9.3f} -> {p_last:9.3f} ms "
