@@ -15,6 +15,20 @@ this 64-bit value never crosses the jit boundary); `disable=` silences
 the named rules. Both forms should carry a rationale — the lint does
 not parse it, reviewers do.
 
+Level 3 adds two directive-audit rules and the compiled-program sweep:
+
+- `unregistered-program`: every `jax.jit` / `pl.pallas_call` /
+  `shard_map` call site in the library must carry
+  `# kschedlint: program=<name>` naming a program registered in
+  `program_registry.py`, or a `disable=unregistered-program` waiver
+  WITH a `-- rationale`.
+- `stale-waiver`: a directive that suppresses nothing (and a
+  `program=` annotation attached to no call site) is itself an error —
+  waivers can only shrink.
+- `bad-waiver`: an unparseable directive, a `disable=` naming an
+  unknown rule (the classic typo that silently checks nothing), or an
+  `unregistered-program` waiver without a rationale.
+
 Scoping (see docs/static_analysis.md):
 
 - `dtype64` applies to *device-bound* modules: files under the library
@@ -32,6 +46,8 @@ import io
 import tokenize
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .program_registry import SITE_NAMES
 
 #: module names whose import marks a file device-bound for `dtype64`
 _JAX_MODULES = ("jax",)
@@ -444,6 +460,251 @@ def rule_raw_print(ctx: FileContext) -> Iterable[Violation]:
             )
 
 
+# ---------------------------------------------------------------------------
+# Level 3: directive parsing, the compiled-program sweep, waiver audits
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Directive:
+    """One parsed `# kschedlint: ...` comment."""
+
+    line: int
+    kind: str  # "host-only" | "disable" | "program" | "unknown"
+    rules: Tuple[str, ...] = ()
+    program: str = ""
+    has_rationale: bool = False
+    text: str = ""
+
+
+def parse_directive(line: int, comment: str) -> Optional[Directive]:
+    marker = comment.find("kschedlint:")
+    if marker < 0:
+        return None
+    text = comment[marker + len("kschedlint:"):].strip()
+    if text.startswith("host-only"):
+        rest = text[len("host-only"):].strip()
+        return Directive(line, "host-only", has_rationale=bool(rest), text=text)
+    if text.startswith("disable="):
+        body = text[len("disable="):]
+        names_part = body.split("--")[0].split("(")[0]
+        names = tuple(n.strip() for n in names_part.split(",") if n.strip())
+        has_rat = "--" in body and bool(body.split("--", 1)[1].strip())
+        return Directive(line, "disable", rules=names, has_rationale=has_rat, text=text)
+    if text.startswith("program="):
+        body = text[len("program="):]
+        name = body.split("--")[0].split("(")[0].strip()
+        has_rat = ("--" in body and bool(body.split("--", 1)[1].strip())) or "(" in body
+        return Directive(line, "program", program=name, has_rationale=has_rat, text=text)
+    return Directive(line, "unknown", text=text)
+
+
+def iter_directives(ctx: FileContext) -> Iterable[Directive]:
+    for line in sorted(ctx.comments):
+        d = parse_directive(line, ctx.comments[line])
+        if d is not None:
+            yield d
+
+
+@dataclass(frozen=True)
+class ProgramSite:
+    """One jax.jit / pl.pallas_call / shard_map call site."""
+
+    line: int  # anchor: the line of the jit/pallas_call/shard_map token
+    end_line: int  # last line of the call/decorator span
+    kind: str  # "jit" | "pallas_call" | "shard_map"
+    callee: str
+    program: Optional[str] = None  # program= annotation found in the span
+    program_line: Optional[int] = None
+    waiver_line: Optional[int] = None  # disable=unregistered-program line
+
+
+def _site_of_call(node: ast.Call) -> Optional[Tuple[str, str, int]]:
+    """(kind, callee, anchor_line) when the Call compiles a program."""
+    callee = _dotted(node.func)
+    last = callee.rsplit(".", 1)[-1]
+    if callee in ("functools.partial", "partial"):
+        if node.args:
+            inner = _dotted(node.args[0])
+            if inner.rsplit(".", 1)[-1] == "jit":
+                return "jit", inner or "jit", node.args[0].lineno
+        return None
+    if last == "jit":
+        return "jit", callee, node.func.lineno
+    if last == "pallas_call":
+        return "pallas_call", callee, node.func.lineno
+    if "shard_map" in last:  # shard_map / _shard_map / _shard_map_native
+        return "shard_map", callee or last, node.func.lineno
+    return None
+
+
+def collect_program_sites(ctx: FileContext) -> List[ProgramSite]:
+    """Every compiled-program call site, with any `program=` annotation
+    or `disable=unregistered-program` waiver found on the lines the
+    call spans (multi-line `functools.partial(jax.jit, ...)` decorators
+    carry theirs next to the `jax.jit` argument)."""
+    hits: List[Tuple[ast.AST, str, str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            found = _site_of_call(node)
+            if found is not None:
+                hits.append((node, *found))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:  # bare @jax.jit / @jit
+                if not isinstance(deco, ast.Call) and _dotted(deco) in ("jax.jit", "jit"):
+                    hits.append((deco, "jit", _dotted(deco), deco.lineno))
+    sites: List[ProgramSite] = []
+    for node, kind, callee, anchor in hits:
+        end = getattr(node, "end_lineno", None) or node.lineno
+        program = program_line = waiver_line = None
+        for ln in range(node.lineno, end + 1):
+            comment = ctx.comments.get(ln)
+            if not comment:
+                continue
+            d = parse_directive(ln, comment)
+            if d is None:
+                continue
+            if d.kind == "program" and program is None:
+                program, program_line = d.program, ln
+            elif d.kind == "disable" and "unregistered-program" in d.rules \
+                    and waiver_line is None:
+                waiver_line = ln
+        sites.append(ProgramSite(anchor, end, kind, callee, program,
+                                 program_line, waiver_line))
+    sites.sort(key=lambda s: (s.line, s.kind, s.callee))
+    return sites
+
+
+def rule_unregistered_program(ctx: FileContext) -> Iterable[Violation]:
+    """The Level-3 coverage ratchet: a compiled program nobody
+    registered is a program nobody audits — its donation config,
+    scatter policy, and hash stability are all unchecked. Register it
+    in analysis/program_registry.py and annotate the site, or waive
+    with a rationale."""
+    if not ctx.in_library:
+        return
+    for site in collect_program_sites(ctx):
+        if site.program is not None:
+            if site.program in SITE_NAMES:
+                continue
+            yield Violation(
+                ctx.path, "unregistered-program", site.program_line, 0,
+                f"`program={site.program}` names no registered program — "
+                "register it in ksched_tpu/analysis/program_registry.py",
+                ctx.line_text(site.program_line),
+            )
+            continue
+        vline = site.waiver_line or site.line
+        yield Violation(
+            ctx.path, "unregistered-program", vline, 0,
+            f"`{site.callee}` compiles an UNREGISTERED program (no contract "
+            "audit covers it); register it in analysis/program_registry.py "
+            "and annotate `# kschedlint: program=<name>`, or waive with "
+            "`# kschedlint: disable=unregistered-program -- rationale`",
+            ctx.line_text(vline),
+        )
+
+
+#: the directive-audit rules exclude themselves when re-running the
+#: rule set to decide what a directive suppresses
+_WAIVER_AUDIT_RULES = ("stale-waiver", "bad-waiver")
+
+
+def _raw_violations(ctx: FileContext) -> List[Violation]:
+    out: List[Violation] = []
+    for name, fn in RULES.items():
+        if name in _WAIVER_AUDIT_RULES:
+            continue
+        out.extend(fn(ctx))
+    return out
+
+
+def rule_stale_waiver(ctx: FileContext) -> Iterable[Violation]:
+    """A suppression that suppresses nothing is a latent hole: the code
+    it excused is gone (or was fixed), and the directive would silently
+    excuse the NEXT violation someone introduces on that line. Same for
+    a `program=` annotation attached to no call site. Waivers only
+    shrink."""
+    directives = list(iter_directives(ctx))
+    if not directives:
+        return
+    by_line: Dict[int, Set[str]] = {}
+    for v in _raw_violations(ctx):
+        by_line.setdefault(v.line, set()).add(v.rule)
+    program_lines = {
+        s.program_line for s in collect_program_sites(ctx)
+        if s.program_line is not None
+    }
+    for d in directives:
+        if d.kind == "host-only":
+            if "dtype64" not in by_line.get(d.line, ()):
+                yield Violation(
+                    ctx.path, "stale-waiver", d.line, 0,
+                    "`host-only` waiver suppresses nothing (no dtype64 "
+                    "violation on this line) — remove it",
+                    ctx.line_text(d.line),
+                )
+        elif d.kind == "disable":
+            known = [r for r in d.rules if r in RULES]
+            dead = [r for r in known if r not in by_line.get(d.line, ())]
+            if dead:
+                yield Violation(
+                    ctx.path, "stale-waiver", d.line, 0,
+                    f"disable={','.join(dead)} suppresses nothing on this "
+                    "line — remove the dead waiver",
+                    ctx.line_text(d.line),
+                )
+        elif d.kind == "program":
+            if d.line not in program_lines:
+                yield Violation(
+                    ctx.path, "stale-waiver", d.line, 0,
+                    f"`program={d.program}` annotation is attached to no "
+                    "jit/pallas_call/shard_map call site — remove it",
+                    ctx.line_text(d.line),
+                )
+
+
+def rule_bad_waiver(ctx: FileContext) -> Iterable[Violation]:
+    """A malformed directive checks nothing — the typo'd rule name is
+    the classic case (satellite of ISSUE 18: it used to silently
+    disable nothing and nobody noticed)."""
+    for d in iter_directives(ctx):
+        if d.kind == "unknown":
+            yield Violation(
+                ctx.path, "bad-waiver", d.line, 0,
+                f"unrecognized kschedlint directive `{d.text}` (expected "
+                "host-only, disable=<rules> -- rationale, or program=<name>)",
+                ctx.line_text(d.line),
+            )
+        elif d.kind == "disable":
+            unknown = [r for r in d.rules if r not in RULES]
+            if not d.rules:
+                yield Violation(
+                    ctx.path, "bad-waiver", d.line, 0,
+                    "disable= names no rules", ctx.line_text(d.line),
+                )
+            if unknown:
+                yield Violation(
+                    ctx.path, "bad-waiver", d.line, 0,
+                    f"disable= names unknown rule(s) {unknown} — a typo here "
+                    "would silently check nothing",
+                    ctx.line_text(d.line),
+                )
+            if "unregistered-program" in d.rules and not d.has_rationale:
+                yield Violation(
+                    ctx.path, "bad-waiver", d.line, 0,
+                    "an unregistered-program waiver must carry a "
+                    "`-- rationale` (why is this program exempt from the "
+                    "registry audit?)",
+                    ctx.line_text(d.line),
+                )
+        elif d.kind == "program" and not d.program:
+            yield Violation(
+                ctx.path, "bad-waiver", d.line, 0,
+                "program= names nothing", ctx.line_text(d.line),
+            )
+
+
 RULES: Dict[str, Callable[[FileContext], Iterable[Violation]]] = {
     "dtype64": rule_dtype64,
     "implicit-dtype": rule_implicit_dtype,
@@ -452,6 +713,9 @@ RULES: Dict[str, Callable[[FileContext], Iterable[Violation]]] = {
     "mutable-default": rule_mutable_default,
     "bare-except": rule_bare_except,
     "raw-print": rule_raw_print,
+    "unregistered-program": rule_unregistered_program,
+    "stale-waiver": rule_stale_waiver,
+    "bad-waiver": rule_bad_waiver,
 }
 
 #: package whose modules count as "library" for dtype64/raw-print
@@ -501,14 +765,16 @@ def lint_source(path: str, source: str, rules: Optional[Sequence[str]] = None) -
     return out
 
 
-def lint_file(path: str, repo_root: str = ".") -> List[Violation]:
+def lint_file(
+    path: str, repo_root: str = ".", rules: Optional[Sequence[str]] = None
+) -> List[Violation]:
     import os
 
     abs_path = path if os.path.isabs(path) else os.path.join(repo_root, path)
     with open(abs_path, "r", encoding="utf-8") as fh:
         source = fh.read()
     rel = os.path.relpath(abs_path, repo_root)
-    return lint_source(rel, source)
+    return lint_source(rel, source, rules=rules)
 
 
 def iter_py_files(paths: Sequence[str], repo_root: str = "."):
@@ -529,9 +795,59 @@ def iter_py_files(paths: Sequence[str], repo_root: str = "."):
                     yield os.path.relpath(os.path.join(dirpath, fname), repo_root)
 
 
-def lint_paths(paths: Sequence[str], repo_root: str = ".") -> List[Violation]:
+def program_coverage(paths: Sequence[str], repo_root: str = ".") -> Dict[str, object]:
+    """The Level-3 coverage report over library files in `paths`:
+    every jit/pallas_call/shard_map call site bucketed into annotated
+    (carries a `program=` naming a registered program), waived
+    (`disable=unregistered-program`), or unaudited — plus the reverse
+    cross-check: registered site names annotated at NO call site
+    (a registry entry auditing a program that is never compiled from
+    the swept tree is itself a coverage hole)."""
+    annotated: List[Dict[str, object]] = []
+    waived: List[Dict[str, object]] = []
+    unaudited: List[Dict[str, object]] = []
+    seen_programs: Set[str] = set()
+    for rel in iter_py_files(paths, repo_root):
+        import os
+
+        with open(os.path.join(repo_root, rel), "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            ctx = build_context(rel, source)
+        except SyntaxError:
+            continue
+        if not ctx.in_library:
+            continue
+        for site in collect_program_sites(ctx):
+            entry = {
+                "path": ctx.path, "line": site.line, "kind": site.kind,
+                "callee": site.callee,
+            }
+            if site.program is not None and site.program in SITE_NAMES:
+                entry["program"] = site.program
+                annotated.append(entry)
+                seen_programs.add(site.program)
+            elif site.waiver_line is not None:
+                waived.append(entry)
+            else:
+                if site.program is not None:
+                    entry["program"] = site.program  # names no registered spec
+                unaudited.append(entry)
+    unannotated = sorted(SITE_NAMES - seen_programs)
+    return {
+        "annotated": annotated,
+        "waived": waived,
+        "unaudited": unaudited,
+        "unannotated_registered": unannotated,
+        "sites": len(annotated) + len(waived) + len(unaudited),
+    }
+
+
+def lint_paths(
+    paths: Sequence[str], repo_root: str = ".", rules: Optional[Sequence[str]] = None
+) -> List[Violation]:
     out: List[Violation] = []
     for rel in iter_py_files(paths, repo_root):
-        out.extend(lint_file(rel, repo_root))
+        out.extend(lint_file(rel, repo_root, rules=rules))
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out

@@ -749,6 +749,57 @@ def trace_warm_flow(n_raw: int = 20, m_raw: int = 100):
     )
 
 
+def trace_replicated_plan_apply(
+    ki_raw: int, kn_raw: int, n_raw: int = 20, m_raw: int = 100
+):
+    """Abstract trace of the FOURTH (and last) scatter-exempt program:
+    the replicated remainder of a sharded plan sync — inv-order and
+    node-boundary records scattered into the replicated plan tensors
+    (parallel/sharded_solver.replicated_plan_apply_fn). Shipped
+    unaudited in PR 15; the Level-3 registry sweep is what surfaced
+    it."""
+    from ..graph.device_export import pad_record_count
+    from ..graph.slot_plan import INV_RECORD_COLS, NODE_RECORD_COLS
+    from ..parallel.sharded_solver import replicated_plan_apply_fn
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    ki = pad_record_count(ki_raw)
+    kn = pad_record_count(kn_raw)
+    return jax.make_jaxpr(replicated_plan_apply_fn())(
+        _sds((2 * m,)), _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+        _sds((ki, INV_RECORD_COLS)), _sds((kn, NODE_RECORD_COLS)),
+    )
+
+
+def trace_scale_cost(n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the cost pre-scaling program
+    (graph/device_export._scale_cost_fn) — cost * n ahead of a device
+    solve."""
+    from ..graph.device_export import _scale_cost_fn
+
+    _n, m = bucketed_sizes(n_raw, m_raw)
+    return jax.make_jaxpr(_scale_cost_fn())(_sds((m,)), _sds(()))
+
+
+def trace_buffer_fingerprint(n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the single-buffer checksum (the warm-flow
+    audit's runtime/integrity._FP_ONE program)."""
+    from ..runtime.integrity import _device_fp1
+
+    _n, m = bucketed_sizes(n_raw, m_raw)
+    return jax.make_jaxpr(_device_fp1)(_sds((m,)))
+
+
+def trace_corrupt_flip(n_raw: int = 20, m_raw: int = 100):
+    """Abstract trace of the chaos-only poison scatter
+    (runtime/integrity.corrupt_fn): flip one bit of one element. The
+    only registered program with a chaos-only scatter policy."""
+    from ..runtime.integrity import corrupt_fn
+
+    _n, m = bucketed_sizes(n_raw, m_raw)
+    return jax.make_jaxpr(corrupt_fn())(_sds((m,)), _sds(()), _sds(()))
+
+
 TRACERS = {
     "jax": trace_jax,
     "ell": trace_ell,
@@ -756,6 +807,99 @@ TRACERS = {
     "layered": trace_layered,
     "sharded": trace_sharded,
 }
+
+
+# ---------------------------------------------------------------------------
+# AOT builders for the donation/aliasing audit
+# ---------------------------------------------------------------------------
+#
+# Each returns (jitted_callable, abstract_args) for the engine's
+# compiled-executable donation audit: the callable is the REAL cached
+# program factory's output (donate_argnums already applied at the jit
+# site), and the args are the same ShapeDtypeStructs its tracer uses —
+# so `.lower(*args).compile()` exercises exactly the production
+# donation configuration.
+
+
+def aot_delta_apply(ka_raw: int = 5, kn_raw: int = 3, n_raw: int = 20, m_raw: int = 100):
+    from ..graph.device_export import (
+        ARC_RECORD_COLS,
+        NODE_RECORD_COLS,
+        delta_apply_fn,
+        pad_record_count,
+    )
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    ka = pad_record_count(ka_raw)
+    kn = pad_record_count(kn_raw)
+    return delta_apply_fn(), (
+        _sds((n,)), _sds((m,)), _sds((m,)), _sds((m,)), _sds((m,)),
+        _sds((ka, ARC_RECORD_COLS)), _sds((kn, NODE_RECORD_COLS)),
+    )
+
+
+def aot_plan_apply(kp_raw: int = 5, ki_raw: int = 3, n_raw: int = 20, m_raw: int = 100):
+    from ..graph.device_export import pad_record_count
+    from ..graph.slot_plan import (
+        INV_RECORD_COLS,
+        NODE_RECORD_COLS,
+        PLAN_RECORD_COLS,
+        SEG_RECORD_COLS,
+        plan_apply_fn,
+    )
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    e = slot_stable_entry_cap(m)
+    kp = pad_record_count(kp_raw)
+    ki = pad_record_count(ki_raw)
+    ks = pad_record_count(0)
+    kn = pad_record_count(0)
+    return plan_apply_fn(), (
+        _sds((e,)), _sds((e,)), _sds((e,)), _sds((e,)), _sds((2 * m,)),
+        _sds((e,)), _sds((e,), jnp.bool_),
+        _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+        _sds((kp, PLAN_RECORD_COLS)), _sds((ki, INV_RECORD_COLS)),
+        _sds((ks, SEG_RECORD_COLS)), _sds((kn, NODE_RECORD_COLS)),
+    )
+
+
+def aot_sharded_plan_apply(
+    kp_raw: int = 5, ks_raw: int = 3, num_devices: int = 2,
+    n_raw: int = 20, m_raw: int = 100,
+):
+    from ..graph.device_export import pad_record_count
+    from ..graph.slot_plan import PLAN_RECORD_COLS, SEG_RECORD_COLS
+    from ..parallel.sharded_solver import (
+        sharded_entry_extent,
+        sharded_plan_apply_fn,
+    )
+
+    _n, m = bucketed_sizes(n_raw, m_raw)
+    D = num_devices
+    es = sharded_entry_extent(m, D)
+    kp = pad_record_count(kp_raw)
+    ks = pad_record_count(ks_raw)
+    return sharded_plan_apply_fn(_mesh_of(D), "x"), (
+        _sds((D, es)), _sds((D, es)), _sds((D, es)), _sds((D, es)),
+        _sds((D, es)), _sds((D, es), jnp.bool_),
+        _sds((D, kp, PLAN_RECORD_COLS)), _sds((D, ks, SEG_RECORD_COLS)),
+    )
+
+
+def aot_replicated_plan_apply(
+    ki_raw: int = 5, kn_raw: int = 3, n_raw: int = 20, m_raw: int = 100
+):
+    from ..graph.device_export import pad_record_count
+    from ..graph.slot_plan import INV_RECORD_COLS, NODE_RECORD_COLS
+    from ..parallel.sharded_solver import replicated_plan_apply_fn
+
+    n, m = bucketed_sizes(n_raw, m_raw)
+    ki = pad_record_count(ki_raw)
+    kn = pad_record_count(kn_raw)
+    return replicated_plan_apply_fn(), (
+        _sds((2 * m,)), _sds((n,)), _sds((n,)), _sds((n,), jnp.bool_),
+        _sds((ki, INV_RECORD_COLS)), _sds((kn, NODE_RECORD_COLS)),
+    )
 
 
 @functools.lru_cache(maxsize=64)

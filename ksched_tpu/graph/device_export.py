@@ -467,7 +467,7 @@ def delta_apply_fn():
         # (device_warm_flow_fn) and the solvers' last-solve endpoint
         # handles; donating them would tear the buffers out from under
         # those references.
-        @functools.partial(jax.jit, donate_argnums=(0, 3, 4))
+        @functools.partial(jax.jit, donate_argnums=(0, 3, 4))  # kschedlint: program=delta_apply
         def _apply_delta(excess, src, dst, cap, cost, arc_rec, node_rec):
             nid = node_rec[:, 0]
             excess = excess.at[nid].set(node_rec[:, 1])
@@ -498,7 +498,7 @@ def device_warm_flow_fn():
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        @jax.jit  # kschedlint: program=warm_flow
         def _warm_flow(prev_flow, src_prev, dst_prev, src, dst, cap):
             same = (src_prev == src) & (dst_prev == dst)
             return jnp.where(same, jnp.minimum(prev_flow, cap), jnp.int32(0))
@@ -515,7 +515,7 @@ def _scale_cost_fn():
     if _SCALE_COST is None:
         import jax
 
-        @jax.jit
+        @jax.jit  # kschedlint: program=scale_cost
         def _scale(cost, n):
             return cost * n
 
@@ -1062,3 +1062,9 @@ class DeviceResidentState:
                 got = got.reshape(-1)
             if not np.array_equal(got, host):
                 raise bounded_diff(f"device plan mirror {name}", got, host)
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(__name__, "delta_apply", "warm_flow", "scale_cost")

@@ -183,7 +183,7 @@ def plan_apply_fn():
         # All ten plan tensors are DONATED: the scatter updates the
         # persistent buffers in place.
         @functools.partial(
-            jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9)
+            jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9)  # kschedlint: program=plan_apply
         )
         def _apply_plan(
             p_arc, p_sign, p_src, p_dst, inv_order,
@@ -1176,3 +1176,9 @@ class SlotPlanState:
         for node in np.flatnonzero(held):
             assert int(self.node_first[node]) == int(starts[node])
             assert int(self.node_last[node]) == int(starts[node] + caps64[node] - 1)
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(__name__, "plan_apply")

@@ -338,7 +338,7 @@ def _mcmf_kernel(
 
 
 @functools.partial(
-    jax.jit,
+    jax.jit,  # kschedlint: program=mega_solve
     static_argnames=(
         "R", "L", "alpha", "max_supersteps", "tighten_sweeps", "interpret",
         "telemetry_cap",
@@ -396,7 +396,7 @@ def mcmf_loop_pallas(
     if telemetry_cap:
         out_shape.append(jax.ShapeDtypeStruct((telemetry_cap, 8), jnp.int32))
         out_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
-    outs = pl.pallas_call(
+    outs = pl.pallas_call(  # kschedlint: program=mega_solve
         functools.partial(
             _mcmf_kernel,
             R=R, L=L, alpha=alpha, max_supersteps=max_supersteps,
@@ -435,3 +435,9 @@ def mcmf_loop_pallas(
     if telemetry_cap:
         return base + (outs[4],)
     return base
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(__name__, "mega_solve")

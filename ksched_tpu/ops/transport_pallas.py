@@ -451,7 +451,7 @@ def _transport_kernel_tiered(
 
 
 @functools.partial(
-    jax.jit,
+    jax.jit,  # kschedlint: disable=unregistered-program -- transport research kernel, bit-parity gated by tests/test_pallas_transport.py, not a dispatch rung
     static_argnames=("alpha", "max_supersteps", "interpret", "refine_waves"),
 )
 def transport_loop_pallas_tiered(
@@ -466,7 +466,7 @@ def transport_loop_pallas_tiered(
     solve. wLo/wHi: int32[C, Mp] scaled tier costs; R: int32[C, Mp]
     resident capacities; supply: int32[C]; col_cap: int32[Mp]."""
     C, Mp = wLo.shape
-    y, pm, steps, conv = pl.pallas_call(
+    y, pm, steps, conv = pl.pallas_call(  # kschedlint: disable=unregistered-program -- transport research kernel, bit-parity gated by tests/test_pallas_transport.py
         functools.partial(
             _transport_kernel_tiered,
             C=C, Mp=Mp, alpha=alpha, max_supersteps=max_supersteps,
@@ -505,7 +505,7 @@ def transport_loop_pallas_tiered(
 
 
 @functools.partial(
-    jax.jit,
+    jax.jit,  # kschedlint: disable=unregistered-program -- transport research kernel, bit-parity gated by tests/test_pallas_transport.py, not a dispatch rung
     static_argnames=("alpha", "max_supersteps", "interpret", "refine_waves"),
 )
 def transport_loop_pallas(
@@ -526,7 +526,7 @@ def transport_loop_pallas(
     C, Mp = wS.shape
     if pm0 is None:
         pm0 = jnp.zeros((Mp,), jnp.int32)
-    y, pm, steps, conv = pl.pallas_call(
+    y, pm, steps, conv = pl.pallas_call(  # kschedlint: disable=unregistered-program -- transport research kernel, bit-parity gated by tests/test_pallas_transport.py
         functools.partial(
             _transport_kernel,
             C=C, Mp=Mp, alpha=alpha, max_supersteps=max_supersteps,

@@ -373,11 +373,11 @@ def make_sharded_solver(mesh: Mesh, axis: str, alpha: int, max_supersteps: int, 
     out_specs = (spec_repl, spec_repl, spec_repl, spec_repl)
     if telemetry_cap:
         out_specs = out_specs + (spec_repl,)
-    fn = shard_map(
+    fn = shard_map(  # kschedlint: program=sharded_solve
         solve_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **shard_map_kwargs,
     )
-    return jax.jit(fn)
+    return jax.jit(fn)  # kschedlint: program=sharded_solve
 
 
 # ---------------------------------------------------------------------------
@@ -709,11 +709,11 @@ def make_sharded_slot_solver(
     out_specs = (P(), P(), P(), P(), P())
     if telemetry_cap:
         out_specs = out_specs + (P(),)
-    fn = shard_map(
+    fn = shard_map(  # kschedlint: program=sharded_slot_solve
         solve_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **shard_map_kwargs,
     )
-    return jax.jit(fn)
+    return jax.jit(fn)  # kschedlint: program=sharded_slot_solve
 
 
 # ---------------------------------------------------------------------------
@@ -830,12 +830,12 @@ def sharded_plan_apply_fn(mesh: Mesh, axis: str):
                 x[None] for x in (p_arc, p_sign, p_src, p_dst, seg, isstart)
             )
 
-        inner = shard_map(
+        inner = shard_map(  # kschedlint: program=sharded_plan_apply
             body, mesh=mesh,
             in_specs=(P(axis),) * 8, out_specs=(P(axis),) * 6,
             **shard_map_kwargs,
         )
-        fn = jax.jit(inner, donate_argnums=(0, 1, 2, 3, 4, 5))
+        fn = jax.jit(inner, donate_argnums=(0, 1, 2, 3, 4, 5))  # kschedlint: program=sharded_plan_apply
         _SHARDED_PLAN_APPLY[key] = fn
     return fn
 
@@ -850,7 +850,7 @@ def replicated_plan_apply_fn():
     place. Same record scheme as plan_apply_fn's inv/node streams."""
     global _REPL_PLAN_APPLY
     if _REPL_PLAN_APPLY is None:
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))  # kschedlint: program=replicated_plan_apply
         def _apply(inv, first, last, nonempty, inv_rec, node_rec):
             inv = inv.at[inv_rec[:, 0]].set(inv_rec[:, 1])
             nid = node_rec[:, 0]
@@ -896,7 +896,7 @@ def sharded_plan_fingerprint_fn(mesh: Mesh, axis: str):
                 outs.append(lax.psum(jnp.sum(v.astype(i32) * w), axis))
             return jnp.stack(outs)
 
-        entry_fp = shard_map(
+        entry_fp = shard_map(  # kschedlint: program=sharded_plan_fingerprint
             body, mesh=mesh, in_specs=(P(axis),) * 6, out_specs=P(),
             **shard_map_kwargs,
         )
@@ -912,7 +912,7 @@ def sharded_plan_fingerprint_fn(mesh: Mesh, axis: str):
                 ent[4], ent[5], rep[1], rep[2], rep[3],
             ])
 
-        fn = jax.jit(_fp)
+        fn = jax.jit(_fp)  # kschedlint: program=sharded_plan_fingerprint
         _SHARDED_PLAN_FP[key] = fn
     return fn
 
@@ -1312,3 +1312,13 @@ class ShardedJaxSolver(FlowSolver):
             + (problem.flow_offset.astype(np.int64) * problem.cost.astype(np.int64)).sum()  # kschedlint: host-only (int64 objective math on host)
         )
         return FlowResult(flow=flow_np.astype(np.int64), objective=objective, iterations=int(steps))  # kschedlint: host-only (FlowResult contract is int64)
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(
+    __name__,
+    "sharded_solve", "sharded_slot_solve", "sharded_slot_solve_warmp",
+    "sharded_plan_apply", "replicated_plan_apply", "sharded_plan_fingerprint",
+)

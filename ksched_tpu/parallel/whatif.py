@@ -82,7 +82,7 @@ def _batch_solve(wS, supply, col_cap, n_scale, alpha, max_supersteps,
     return jax.lax.map(one, (wS, supply, col_cap))
 
 
-_batch_solve_jit = functools.partial(jax.jit, static_argnames=(
+_batch_solve_jit = functools.partial(jax.jit, static_argnames=(  # kschedlint: disable=unregistered-program -- lax.map batch over the layered solve; the inner program is registered as layered_solve
     "n_scale", "alpha", "max_supersteps", "class_degenerate"
 ))(_batch_solve)
 

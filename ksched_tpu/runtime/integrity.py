@@ -192,7 +192,7 @@ def state_fingerprint_fn():
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        @jax.jit  # kschedlint: program=state_fingerprint
         def _fp_state(excess, src, dst, cap, cost):
             return jnp.stack(
                 [_device_fp1(x) for x in (excess, src, dst, cap, cost)]
@@ -213,7 +213,7 @@ def plan_fingerprint_fn():
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        @jax.jit  # kschedlint: program=plan_fingerprint
         def _fp_plan(*tensors):
             return jnp.stack([_device_fp1(x) for x in tensors])
 
@@ -248,7 +248,7 @@ def corrupt_fn():
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        @jax.jit  # kschedlint: program=corrupt_flip
         def _flip(buf, idx, bit):
             return buf.at[idx].set(buf[idx] ^ (jnp.int32(1) << bit))
 
@@ -561,7 +561,7 @@ def _one_fp(buf):
     if _FP_ONE is None:
         import jax
 
-        _FP_ONE = jax.jit(_device_fp1)
+        _FP_ONE = jax.jit(_device_fp1)  # kschedlint: program=buffer_fingerprint
     return _FP_ONE(buf)
 
 
@@ -713,3 +713,13 @@ def corrupt_wal_file(path: str, mode: str, rng) -> None:
         f.write(WAL_MAGIC)
         for fr in frames:
             f.write(fr)
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(
+    __name__,
+    "state_fingerprint", "plan_fingerprint", "buffer_fingerprint",
+    "corrupt_flip",
+)

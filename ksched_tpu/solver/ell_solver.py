@@ -201,7 +201,7 @@ def _g2(table, idx2):
     return table[idx2]
 
 @functools.partial(
-    jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap")
+    jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap")  # kschedlint: program=ell_solve
 )
 def _solve_mcmf_ell(
     cap, cost, supply, flow0, eps_init,
@@ -605,3 +605,9 @@ class EllSolver(FlowSolver):
 
     def solve(self, problem: FlowProblem) -> FlowResult:
         return self.complete(self.solve_async(problem))
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(__name__, "ell_solve")

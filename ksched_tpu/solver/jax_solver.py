@@ -168,7 +168,7 @@ def _seg_min(vals, isstart, node_last, node_nonempty, identity):
 _BIG_D = 1 << 28  # "unreachable" distance sentinel for price tightening
 
 
-@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap", "use_warm_p", "slot_stable"))
+@functools.partial(jax.jit, static_argnames=("alpha", "max_supersteps", "tighten_sweeps", "telemetry_cap", "use_warm_p", "slot_stable"))  # kschedlint: program=csr_solve
 def _solve_mcmf(
     cap, cost, supply, flow0, eps_init,
     s_arc, s_sign, s_src, s_dst, s_segstart, s_isstart, inv_order,
@@ -505,7 +505,7 @@ def stacked_solve_fn(
             def lane(cap, cost, supply, flow0, eps, *plan):
                 return _solve_mcmf(cap, cost, supply, flow0, eps, *plan, **statics)
 
-        fn = jax.jit(jax.vmap(lane))
+        fn = jax.jit(jax.vmap(lane))  # kschedlint: program=stacked_solve
         _STACKED_SOLVES[key] = fn
     return fn
 
@@ -992,3 +992,14 @@ class JaxSolver(FlowSolver):
 
     def solve(self, problem: FlowProblem) -> FlowResult:
         return self.complete(self.solve_async(problem))
+
+
+# Level-3 registry ownership: the programs this module compiles
+# (ksched_tpu/analysis/program_registry.py; audited by analysis/engine.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(
+    __name__,
+    "csr_solve", "csr_solve_warmp", "csr_solve_slot", "csr_refit_slot",
+    "stacked_solve", "stacked_solve_warmp",
+)

@@ -1050,7 +1050,7 @@ def transport_fori(wS, supply, col_cap, num_supersteps: int, alpha: int = 8,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("alpha", "max_supersteps", "refine_waves", "telemetry_cap")
+    jax.jit, static_argnames=("alpha", "max_supersteps", "refine_waves", "telemetry_cap")  # kschedlint: program=layered_solve
 )
 def _solve_transport(
     wS,  # int32[C, Mp1] scaled costs (column Mp1-1 = unsched, 0)
@@ -1267,3 +1267,9 @@ class LayeredTransportSolver:
         self.last_supersteps = res.supersteps
         self.last_telemetry = decode_last()
         return res
+
+
+# Level-3 registry ownership (ksched_tpu/analysis/program_registry.py)
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(__name__, "layered_solve")

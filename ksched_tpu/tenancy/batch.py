@@ -567,3 +567,10 @@ class LaneSolver(FlowSolver):
 
     def solve(self, problem: FlowProblem) -> FlowResult:
         return self.complete(self.solve_async(problem))
+
+
+# Level-3 registry consumer hook: the batched cell solve dispatches the
+# lane-stacked program owned by solver/jax_solver.py
+from ..analysis.program_registry import declare_programs as _declare_programs
+
+_declare_programs(__name__, "stacked_solve")

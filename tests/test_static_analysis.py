@@ -5,52 +5,50 @@ unsuppressed, unbaselined violations), and every rule must actually
 fire on a seeded bad snippet — a lint that silently stopped matching
 is worse than no lint.
 
-Level 2: the jaxpr contracts hold for every registered backend at 3
-representative shape buckets — no 64-bit converts, no scatters, the
-megakernel's zero-HBM-gather budget, pow2-bucket jaxpr-hash stability,
-and the VMEM estimate consistent with `mega_fits_vmem` — plus negative
-tests proving each contract detects a seeded violation.
+Level 2: generic trace-level machinery (jaxpr_contracts) plus negative
+tests proving each analysis detects a seeded violation.
+
+Level 3 (ISSUE 18): the declarative program registry drives the whole
+per-program sweep — one parametrized test runs every applicable check
+(dtype/scatter/gather/collective contracts, telemetry-off hash pin,
+pow2-bucket hash stability, telemetry-knob semantics, variant
+distinctness, the compiled donation/aliasing audit, the mega VMEM
+gate, module ownership) for every registered program. The hand-written
+per-program test functions this replaces live on as registry data.
 """
 
+import dataclasses
 import json
 import os
+import subprocess
+import sys
 import textwrap
 
-import numpy as np
 import pytest
 
 from ksched_tpu.analysis import (
     RULES,
     lint_paths,
     load_baseline,
+    program_coverage,
     split_by_baseline,
 )
-from ksched_tpu.analysis.ast_rules import lint_source
+from ksched_tpu.analysis.ast_rules import collect_program_sites, build_context, lint_source
 from ksched_tpu.analysis import jaxpr_contracts as jc
+from ksched_tpu.analysis import engine
+from ksched_tpu.analysis.program_registry import (
+    PROGRAMS,
+    SITE_NAMES,
+    CollectiveBudget,
+    DonationSpec,
+    HashStability,
+    call,
+    donating_programs,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LINT_TARGETS = ["ksched_tpu", "tools", "bench.py"]
-
-#: 3 representative (n, m) shape buckets — interpreted as (C, M) by the
-#: layered backend — small enough that abstract tracing stays cheap
-SHAPE_BUCKETS = [(12, 40), (20, 100), (40, 220)]
-
-#: raw-size pairs sharing a pow2 bucket, per hash-stable backend:
-#: (n pads 16/32/64..., m pads to next_pow2(max(.,16)); layered M pads
-#: to a multiple of 128 via pad_geometry with C untouched)
-BUCKET_PAIRS = {
-    "jax": [((12, 40), (15, 60)), ((20, 100), (30, 70)), ((40, 220), (60, 200))],
-    "mega": [((12, 40), (15, 60)), ((20, 100), (30, 70)), ((40, 220), (60, 200))],
-    "layered": [((4, 40), (4, 100)), ((4, 130), (4, 250)), ((8, 300), (8, 370))],
-}
-
-#: and pairs in DIFFERENT buckets, which must produce different jaxprs
-#: (otherwise the stability check is vacuous)
-CROSS_BUCKET_PAIRS = {
-    "jax": ((12, 40), (12, 200)),
-    "mega": ((12, 40), (12, 2000)),
-    "layered": ((4, 40), (4, 300)),
-}
+BASELINE = os.path.join(REPO_ROOT, "tools", "kschedlint_baseline.json")
 
 
 # ---------------------------------------------------------------------------
@@ -60,25 +58,23 @@ CROSS_BUCKET_PAIRS = {
 
 def test_repo_is_lint_clean():
     violations = lint_paths(LINT_TARGETS, repo_root=REPO_ROOT)
-    baseline = load_baseline(os.path.join(REPO_ROOT, "tools", "kschedlint_baseline.json"))
-    new, _old, _stale = split_by_baseline(violations, baseline)
+    baseline = load_baseline(BASELINE)
+    new, _old, stale = split_by_baseline(violations, baseline)
     assert not new, "new kschedlint violations:\n" + "\n".join(
         v.render() for v in new
     )
+    assert not stale, f"stale baseline entries (fixed debt): {dict(stale)}"
 
 
 def test_baseline_is_empty():
     """The ratchet starts clean: every seed violation was fixed or
     suppressed inline with a rationale (ISSUE 3 acceptance)."""
-    with open(os.path.join(REPO_ROOT, "tools", "kschedlint_baseline.json")) as fh:
+    with open(BASELINE) as fh:
         data = json.load(fh)
     assert data["violations"] == []
 
 
 def test_cli_exits_zero():
-    import subprocess
-    import sys
-
     proc = subprocess.run(
         [sys.executable, "-m", "tools.kschedlint", "ksched_tpu", "tools", "bench.py"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
@@ -87,7 +83,7 @@ def test_cli_exits_zero():
 
 
 # ---------------------------------------------------------------------------
-# Level 1: every rule fires on a seeded bad snippet
+# Level 1+3: every rule fires on a seeded bad snippet
 # ---------------------------------------------------------------------------
 
 BAD_SNIPPETS = {
@@ -141,6 +137,21 @@ BAD_SNIPPETS = {
         def report(msg):
             print(msg)
     """,
+    "unregistered-program": """
+        import jax
+
+        fn = jax.jit(lambda x: x + 1)
+    """,
+    "stale-waiver": """
+        import jax
+
+        x = 1  # kschedlint: disable=raw-print -- nothing here prints
+    """,
+    "bad-waiver": """
+        import jax
+
+        y = 2  # kschedlint: disable=raw-pirnt -- typo'd rule name
+    """,
 }
 
 
@@ -166,14 +177,20 @@ def test_suppression_comment_silences_rule():
 def test_suppression_does_not_leak_to_other_rules():
     source = (
         "import numpy as np\nimport jax\n"
-        "x = np.zeros(4, dtype=np.int64)  # kschedlint: disable=raw-print\n"
+        "x = np.zeros(4, dtype=np.int64)  "
+        "# kschedlint: disable=raw-print -- wrong rule on purpose\n"
     )
-    assert [v.rule for v in lint_source("ksched_tpu/_s.py", source)] == ["dtype64"]
+    rules = [v.rule for v in lint_source("ksched_tpu/_s.py", source)]
+    # the dtype64 violation survives; the raw-print waiver is dead on
+    # this line, so the staleness audit also fires
+    assert "dtype64" in rules and "stale-waiver" in rules
 
 
 def test_baseline_is_a_multiset():
     """One baselined entry waives ONE occurrence: copy-pasting an
     accepted bad line elsewhere in the file still fails the gate."""
+    from collections import Counter
+
     from ksched_tpu.analysis.baseline import fingerprint as fp
 
     source = (
@@ -181,8 +198,6 @@ def test_baseline_is_a_multiset():
         "a = np.zeros(4, dtype=np.int64)\n"
         "b = np.zeros(4, dtype=np.int64)\n"
     )
-    from collections import Counter
-
     violations = lint_source("ksched_tpu/_dup.py", source)
     assert len(violations) == 2
     e = fp(violations[0])
@@ -217,68 +232,278 @@ def test_is_none_branch_is_not_flagged():
 
 
 # ---------------------------------------------------------------------------
-# Level 2: jaxpr contracts for every registered backend
+# Level 3: the unaudited-program sweep
 # ---------------------------------------------------------------------------
 
 
-def test_backend_registry_matches_select():
-    """The contract suite must trace what select.py can hand out: every
-    in-process array backend name in make_backend appears here."""
+def _sweep(source):
+    return [
+        v for v in lint_source("ksched_tpu/_sweep.py", textwrap.dedent(source))
+        if v.rule == "unregistered-program"
+    ]
+
+
+def test_sweep_finds_every_compile_entry_point():
+    hits = _sweep("""
+        import functools
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.experimental.shard_map import shard_map
+
+        f1 = jax.jit(lambda x: x)
+
+        @jax.jit
+        def f2(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f3(x, k: int = 2):
+            return x * k
+
+        def f4(x):
+            return pl.pallas_call(lambda ref, o: None, out_shape=x)(x)
+
+        def f5(fn, mesh, spec):
+            return shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)
+    """)
+    assert len(hits) == 5, [(v.line, v.message) for v in hits]
+
+
+def test_sweep_accepts_registered_annotation_and_waiver():
+    assert not _sweep("""
+        import jax
+
+        f1 = jax.jit(lambda x: x)  # kschedlint: program=csr_solve
+        f2 = jax.jit(lambda x: x)  # kschedlint: disable=unregistered-program -- test scaffolding
+    """)
+
+
+def test_sweep_rejects_unknown_program_name():
+    hits = _sweep("""
+        import jax
+
+        f1 = jax.jit(lambda x: x)  # kschedlint: program=no_such_program
+    """)
+    assert len(hits) == 1 and "names no registered program" in hits[0].message
+
+
+def test_sweep_annotation_found_across_multiline_span():
+    """A decorator like @functools.partial(jax.jit, donate_argnums=...)
+    spans lines; the annotation rides whichever line is natural."""
+    assert not _sweep("""
+        import functools
+        import jax
+
+        @functools.partial(
+            jax.jit,  # kschedlint: program=delta_apply
+            donate_argnums=(0,),
+        )
+        def apply(buf):
+            return buf + 1
+    """)
+
+
+def test_sweep_ignores_non_library_and_method_names():
+    # outside ksched_tpu/: no sweep
+    src = "import jax\nfn = jax.jit(lambda x: x)\n"
+    assert not [
+        v for v in lint_source("tools/_t.py", src)
+        if v.rule == "unregistered-program"
+    ]
+    # a method merely NAMED like the wrapped callable is not a site
+    assert not _sweep("""
+        class Cell:
+            def _round_jit(self, x):
+                return x
+
+            def step(self, x):
+                return self._round_jit(x)
+    """)
+
+
+def test_unregistered_program_waiver_requires_rationale():
+    hits = [
+        v for v in lint_source("ksched_tpu/_w.py", textwrap.dedent("""
+            import jax
+
+            f1 = jax.jit(lambda x: x)  # kschedlint: disable=unregistered-program
+        """))
+        if v.rule == "bad-waiver"
+    ]
+    assert len(hits) == 1 and "rationale" in hits[0].message
+
+
+def test_stale_program_annotation_is_flagged():
+    hits = [
+        v for v in lint_source("ksched_tpu/_w.py", textwrap.dedent("""
+            import jax
+
+            x = 1  # kschedlint: program=csr_solve
+        """))
+        if v.rule == "stale-waiver"
+    ]
+    assert len(hits) == 1 and "no jit/pallas_call/shard_map" in hits[0].message
+
+
+def test_stale_host_only_waiver_is_flagged():
+    hits = [
+        v for v in lint_source("ksched_tpu/_w.py", textwrap.dedent("""
+            import numpy as np
+            import jax
+
+            x = np.zeros(4, dtype=np.int32)  # kschedlint: host-only (nothing 64-bit here)
+        """))
+        if v.rule == "stale-waiver"
+    ]
+    assert len(hits) == 1 and "host-only" in hits[0].message
+
+
+def test_live_waivers_are_not_stale():
+    source = (
+        "import numpy as np\nimport jax\n"
+        "x = np.zeros(4, dtype=np.int64)  # kschedlint: host-only (test)\n"
+    )
+    assert not any(
+        v.rule == "stale-waiver" for v in lint_source("ksched_tpu/_w.py", source)
+    )
+
+
+def test_bad_waiver_catches_unknown_directive_and_empty_disable():
+    src = textwrap.dedent("""
+        import jax
+
+        a = 1  # kschedlint: supress=raw-print
+        b = 2  # kschedlint: disable= -- nothing named
+    """)
+    rules = [v.rule for v in lint_source("ksched_tpu/_w.py", src)]
+    assert rules.count("bad-waiver") == 2
+
+
+def test_repo_coverage_is_total():
+    """The ISSUE 18 acceptance: 100% call-site coverage — every
+    jit/pallas_call/shard_map site in the library is annotated with a
+    registered program or waived with a rationale, and every registered
+    site name is annotated somewhere."""
+    cov = program_coverage(LINT_TARGETS, repo_root=REPO_ROOT)
+    assert cov["unaudited"] == [], cov["unaudited"]
+    assert cov["unannotated_registered"] == []
+    assert cov["sites"] == len(cov["annotated"]) + len(cov["waived"])
+    assert len(cov["annotated"]) >= len(SITE_NAMES)
+
+
+def test_collect_program_sites_classifies_kinds():
+    ctx = build_context("ksched_tpu/_k.py", textwrap.dedent("""
+        import jax
+        from jax.experimental import pallas as pl
+
+        a = jax.jit(lambda x: x)  # kschedlint: program=csr_solve
+        b = pl.pallas_call(lambda r, o: None)  # kschedlint: program=mega_solve
+    """))
+    kinds = {s.kind for s in collect_program_sites(ctx)}
+    assert kinds == {"jit", "pallas_call"}
+
+
+# ---------------------------------------------------------------------------
+# Level 3: registry sanity
+# ---------------------------------------------------------------------------
+
+
+def test_registry_matches_select():
+    """The registry must cover what select.py can hand out: every
+    in-process array backend rung has a registered solve program."""
     with open(os.path.join(REPO_ROOT, "ksched_tpu", "solver", "select.py")) as fh:
         select_src = fh.read()
-    for name in ("jax", "ell", "mega", "layered"):
-        assert f'name == "{name}"' in select_src
-        assert name in jc.REGISTERED_BACKENDS
-    assert "sharded" in jc.REGISTERED_BACKENDS  # parallel/sharded_*
+    for rung, program in (
+        ("jax", "csr_solve"), ("ell", "ell_solve"), ("mega", "mega_solve"),
+        ("layered", "layered_solve"),
+    ):
+        assert f'name == "{rung}"' in select_src
+        assert program in PROGRAMS
+    assert "sharded_solve" in PROGRAMS  # parallel/ rung
 
 
-@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
-@pytest.mark.parametrize("backend", jc.REGISTERED_BACKENDS)
-def test_contracts_no_64bit_no_scatter(backend, bucket):
-    report = jc.backend_report(backend, *bucket)
-    assert report.ok_64bit, report.violations_64bit
-    assert report.ok_scatter, report.scatter_eqns
-    assert report.num_eqns > 0
+def test_registry_policies_are_coherent():
+    """Solve and audit programs never scatter; every scoped exemption
+    is a maintenance program; chaos programs are never donation-audited
+    (they are never dispatched in production)."""
+    for spec in PROGRAMS.values():
+        if spec.kind in ("solve", "audit"):
+            assert spec.scatter_policy == "forbidden", spec.name
+        if spec.scatter_policy == "scoped-exempt":
+            assert spec.kind == "maintenance", spec.name
+        if spec.kind == "chaos":
+            assert spec.donation is None, spec.name
+    assert len(donating_programs()) == 4
+    assert {s.name for s in donating_programs()} == {
+        "delta_apply", "plan_apply", "sharded_plan_apply", "replicated_plan_apply",
+    }
 
 
-@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
-def test_mega_gather_budget_zero(bucket):
-    """PR 1's claim, locked in: zero per-superstep HBM gathers. The
-    loop lives inside the pallas_call whose operands are all VMEM/SMEM
-    by BlockSpec; in-kernel gathers are exactly the pinned partner-
-    permutation reads; outside the kernel, gathers only run once per
-    solve (the entry materialization), never inside a loop."""
-    report = jc.backend_report("mega", *bucket)
-    assert report.hbm_loop_gathers == 0
-    assert report.kernel_gathers == jc.MEGA_KERNEL_PERM_GATHERS
-    est = jc.estimate_mega_vmem(jc.traced("mega", *bucket))
-    assert est.all_operands_on_chip
+def test_registry_pins_are_the_pretelemetry_baselines():
+    """The five telemetry-off hash pins captured on the pre-telemetry
+    tree (PR 7 base, jax 0.4.37) now live in the registry; this literal
+    copy guards against an accidental registry edit re-pinning them.
+    A jax upgrade that changes jaxpr printing re-pins BOTH in the same
+    commit (verify the off-trace is otherwise unchanged first)."""
+    assert {
+        n: s.telemetry_off_hash
+        for n, s in PROGRAMS.items() if s.telemetry_off_hash
+    } == {
+        "csr_solve": "92aa144400bd8869",
+        "ell_solve": "9e101ad7b1bac615",
+        "mega_solve": "2713247f0ce0fa0b",
+        # sharded traces over the conftest 8-virtual-device mesh; its
+        # hash is mesh-size-dependent (the others' are not)
+        "sharded_solve": "b2c5ad0884934f47",
+        "layered_solve": "efaf297e81829bd2",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Level 3: the engine enforces every registered program's contract.
+# One test id per (program, applicable check) — skipped work would be
+# visible as absent ids, not silently-passing ones.
+# ---------------------------------------------------------------------------
+
+REGISTRY_CASES = [
+    (name, check)
+    for name in sorted(PROGRAMS)
+    for check in engine.applicable_checks(PROGRAMS[name])
+]
+
+
+@pytest.mark.parametrize(
+    "program,check", REGISTRY_CASES, ids=[f"{p}-{c}" for p, c in REGISTRY_CASES]
+)
+def test_program_contract(program, check):
+    engine.CHECKS[check](PROGRAMS[program])
+
+
+def test_every_program_gets_contract_and_ownership_checks():
+    for spec in PROGRAMS.values():
+        checks = engine.applicable_checks(spec)
+        assert "contracts" in checks and "declared" in checks, spec.name
+
+
+# ---------------------------------------------------------------------------
+# Level 2/3 bespoke: checks the generic engine cannot express
+# ---------------------------------------------------------------------------
 
 
 def test_csr_backend_shows_the_contrast():
     """The scan-CSR backend pays per-superstep HBM gathers (that is
     the megakernel's whole reason to exist) — if this ever reads 0 the
-    gather classifier is broken, not the solver fixed."""
-    report = jc.backend_report("jax", 20, 100)
+    gather classifier is broken, not the solver fixed. (The registry
+    pins this as csr_solve's hbm_loop_min=1 canary; asserted directly
+    here so a GatherBudget refactor can't drop it.)"""
+    report = engine.report(PROGRAMS["csr_solve"])
     assert report.hbm_loop_gathers > 0
 
 
-@pytest.mark.parametrize("backend", sorted(BUCKET_PAIRS))
-def test_pow2_bucket_jaxpr_hash_stable(backend):
-    for raw_a, raw_b in BUCKET_PAIRS[backend]:
-        ha, hb = jc.recompile_hazard(backend, raw_a, raw_b)
-        assert ha == hb, (
-            f"{backend}: raw sizes {raw_a} and {raw_b} share a pow2 bucket "
-            "but trace different jaxprs — a raw size is leaking into the "
-            "traced program (recompile hazard)"
-        )
-    raw_a, raw_b = CROSS_BUCKET_PAIRS[backend]
-    ha, hb = jc.recompile_hazard(backend, raw_a, raw_b)
-    assert ha != hb, "cross-bucket hashes collide; the stability check is vacuous"
-
-
-@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
-def test_mega_vmem_estimate_consistent_with_gate(bucket):
+def test_mega_gate_refuses_exactly_where_estimate_exceeds_budget():
+    """Beyond check_vmem_gate's safety/tightness: the dispatch gate's
+    refusal boundary must coincide with the counted estimate across
+    entry counts spanning tiny to beyond-budget."""
     from ksched_tpu.ops.mcmf_pallas import (
         _MEGA_VMEM_BUDGET_BYTES,
         MEGA_LANES,
@@ -286,18 +511,7 @@ def test_mega_vmem_estimate_consistent_with_gate(bucket):
         mega_fits_vmem,
     )
 
-    est = jc.estimate_mega_vmem(jc.traced("mega", *bucket))
-    assert est.L == MEGA_LANES
-    assert est.gate_is_safe, (
-        f"kernel live set ({est.est_tiles} tiles) exceeds the "
-        f"_MEGA_LIVE_TILES gate ({est.gate_tiles}): mega_fits_vmem would "
-        "admit solves that cannot be VMEM-resident — raise the gate"
-    )
-    assert est.gate_is_tight, (
-        f"gate ({est.gate_tiles} tiles) is far above the counted live set "
-        f"({est.est_tiles}): it has drifted from the kernel it guards"
-    )
-    # the gate refuses exactly where the counted estimate exceeds budget
+    est = jc.estimate_mega_vmem(engine.trace_call(PROGRAMS["mega_solve"]))
     for entries in (512, 1 << 15, 1 << 18, 1 << 20, 1 << 22):
         padded = mega_entry_rows(entries) * MEGA_LANES
         counted_fits = est.gate_tiles * padded * 4 <= _MEGA_VMEM_BUDGET_BYTES
@@ -305,405 +519,7 @@ def test_mega_vmem_estimate_consistent_with_gate(bucket):
 
 
 # ---------------------------------------------------------------------------
-# Level 2: solver-telemetry contracts (obs/soltel.py, ISSUE 7)
-# ---------------------------------------------------------------------------
-
-#: normalized jaxpr hashes of every backend's TELEMETRY-OFF trace at
-#: bucket (20, 100), captured on the pre-telemetry tree (PR 7 base,
-#: jax 0.4.37) — the "no cost when off" contract: telemetry_cap=0 must
-#: trace the EXACT pre-soltel program, op for op. The hash normalizes
-#: source-location metadata (jaxpr_contracts._normalize_jaxpr_str), so
-#: a comment edit can't split it — but a jax upgrade that changes
-#: jaxpr printing will, and these pins must then be re-captured in the
-#: same commit as the upgrade (verify the off-trace is otherwise
-#: unchanged first).
-SOLTEL_OFF_BASELINE_HASHES = {
-    "jax": "92aa144400bd8869",
-    "ell": "9e101ad7b1bac615",
-    "mega": "2713247f0ce0fa0b",
-    # sharded traces over the conftest 8-virtual-device mesh; its hash
-    # is mesh-size-dependent (the other backends' are not)
-    "sharded": "b2c5ad0884934f47",
-    "layered": "efaf297e81829bd2",
-}
-
-
-@pytest.mark.parametrize("backend", sorted(SOLTEL_OFF_BASELINE_HASHES))
-def test_soltel_off_trace_is_the_pretelemetry_baseline(backend):
-    got = jc.jaxpr_hash(jc.traced(backend, 20, 100))
-    assert got == SOLTEL_OFF_BASELINE_HASHES[backend], (
-        f"{backend}: the telemetry-OFF trace drifted from the "
-        "pre-telemetry baseline — disabled solver telemetry must cost "
-        "zero traced ops (see SOLTEL_OFF_BASELINE_HASHES)"
-    )
-
-
-@pytest.mark.parametrize("backend", sorted(SOLTEL_OFF_BASELINE_HASHES))
-def test_soltel_on_changes_and_off_matches_default(backend):
-    """Sanity for the pin above: telemetry-on traces a DIFFERENT
-    program (the contract isn't vacuous), and cap=0 is the default.
-    Every soltel contract test traces cap=512 so the lru cache shares
-    the (expensive) abstract traces across the suite."""
-    off = jc.jaxpr_hash(jc.traced(backend, 20, 100, telemetry_cap=0))
-    on = jc.jaxpr_hash(jc.traced(backend, 20, 100, telemetry_cap=512))
-    assert off == jc.jaxpr_hash(jc.traced(backend, 20, 100))
-    assert on != off
-
-
-@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
-def test_soltel_mega_gather_budget_unchanged(bucket):
-    """Telemetry must add ZERO gathers to the megakernel: the counters
-    are reductions over VMEM state the superstep already holds, and
-    the ring write is a masked elementwise select."""
-    report = jc.check_jaxpr(
-        "mega", jc.traced("mega", *bucket, telemetry_cap=512)
-    )
-    assert report.hbm_loop_gathers == 0
-    assert report.kernel_gathers == jc.MEGA_KERNEL_PERM_GATHERS
-    assert report.ok_64bit and report.ok_scatter
-
-
-@pytest.mark.parametrize("bucket", SHAPE_BUCKETS, ids=str)
-def test_soltel_mega_vmem_estimate_within_one_tile(bucket):
-    """The telemetry ring is clamped to one [R, L] entry tile
-    (mega_telemetry_cap), so the counted VMEM estimate grows by
-    exactly 1 tile over _MEGA_LIVE_TILES — matching what
-    mega_fits_vmem(telemetry=True) budgets."""
-    from ksched_tpu.ops.mcmf_pallas import _MEGA_LIVE_TILES
-
-    est = jc.estimate_mega_vmem(
-        jc.traced("mega", *bucket, telemetry_cap=512)
-    )
-    assert est.extra_tiles == 1
-    assert est.est_tiles <= _MEGA_LIVE_TILES + 1
-    assert est.all_operands_on_chip
-    assert est.gate_is_safe
-
-
-@pytest.mark.parametrize("backend", ("jax", "mega", "layered"))
-def test_soltel_on_pow2_bucket_hash_stable(backend):
-    """The recompile detector holds WITH telemetry on: the ring shape
-    is a function of the pow2 bucket alone, never the raw size. One
-    pair per backend — the off-trace pairs already sweep all three;
-    this guards the telemetry shapes specifically."""
-    raw_a, raw_b = BUCKET_PAIRS[backend][0]
-    ha = jc.jaxpr_hash(jc.traced(backend, *raw_a, telemetry_cap=512))
-    hb = jc.jaxpr_hash(jc.traced(backend, *raw_b, telemetry_cap=512))
-    assert ha == hb, f"{backend}: telemetry-on recompile hazard {raw_a} vs {raw_b}"
-
-
-@pytest.mark.parametrize("backend", ("jax", "ell", "layered", "sharded"))
-def test_soltel_on_no_64bit_no_scatter(backend):
-    report = jc.check_jaxpr(
-        backend, jc.traced(backend, 20, 100, telemetry_cap=512)
-    )
-    assert report.ok_64bit, report.violations_64bit
-    assert report.ok_scatter, report.scatter_eqns
-
-
-# ---------------------------------------------------------------------------
-# Device-resident delta program: the SCOPED scatter exemption
-# ---------------------------------------------------------------------------
-
-
-def test_delta_apply_scatters_and_is_32bit():
-    """The delta-apply program IS allowed scatters — it applies
-    O(churn)-sized packed records once per round, where a serialized
-    scatter is the right tool — and the exemption must not be vacuous:
-    the traced program really contains scatter ops. Everything stays
-    32-bit (the device mirror never carries int64)."""
-    report = jc.check_jaxpr("delta_apply", jc.trace_delta_apply(5, 3))
-    assert report.scatter_eqns, (
-        "the delta-apply trace contains no scatters — the scoped "
-        "exemption is vacuous (did the program change shape?)"
-    )
-    assert report.ok_64bit, report.violations_64bit
-
-
-def test_delta_apply_exemption_is_scoped():
-    """The exemptions cover EXACTLY THREE programs (the problem-delta
-    apply, the slot-stable plan apply, and the per-shard routed
-    sharded plan apply — all once-per-round maintenance outside any
-    solve): every registered solver backend still traces zero scatters
-    (the existing per-backend sweep re-asserted here so the exemption
-    tests and the zero-scatter rule can never pass for contradictory
-    reasons)."""
-    for backend in jc.REGISTERED_BACKENDS:
-        report = jc.backend_report(backend, 20, 100)
-        assert report.ok_scatter, (backend, report.scatter_eqns)
-
-
-def test_delta_apply_pow2_record_bucket_hash_stable():
-    """Two record counts sharing a pow2 bucket trace byte-identical
-    delta programs (one compiled scatter per bucket, no per-delta
-    recompiles); cross-bucket hashes differ (the check isn't vacuous).
-    The graph bucket behaves the same way."""
-    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2)) == jc.jaxpr_hash(
-        jc.trace_delta_apply(7, 5)
-    )
-    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2)) != jc.jaxpr_hash(
-        jc.trace_delta_apply(100, 2)
-    )
-    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2, n_raw=20, m_raw=100)) == jc.jaxpr_hash(
-        jc.trace_delta_apply(3, 2, n_raw=24, m_raw=110)
-    )
-    assert jc.jaxpr_hash(jc.trace_delta_apply(3, 2, n_raw=20, m_raw=100)) != jc.jaxpr_hash(
-        jc.trace_delta_apply(3, 2, n_raw=20, m_raw=300)
-    )
-
-
-def test_warm_flow_program_is_elementwise():
-    """The device warm-flow carry must stay scatter- AND gather-free
-    (pure elementwise masking against the pre-delta endpoints)."""
-    report = jc.check_jaxpr("warm_flow", jc.trace_warm_flow())
-    assert report.ok_scatter, report.scatter_eqns
-    assert report.ok_64bit, report.violations_64bit
-    assert (
-        report.hbm_loop_gathers == report.kernel_gathers
-        == report.oneshot_gathers == 0
-    )
-
-
-def test_warmp_trace_is_distinct_and_scatter_free():
-    """use_warm_p=True is a DIFFERENT traced program — since the
-    dirty-frontier refit it consumes the carried potentials as the
-    Bellman seed — still zero scatters, no 64-bit, pow2-bucket stable.
-    The DEFAULT trace staying on the pinned pre-warm_p baseline is
-    asserted by test_soltel_off_trace_is_the_pretelemetry_baseline."""
-    closed = jc.trace_jax_warmp(20, 100)
-    report = jc.check_jaxpr("jax+warmp", closed)
-    assert report.ok_scatter and report.ok_64bit
-    assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.traced("jax", 20, 100))
-    assert jc.jaxpr_hash(jc.trace_jax_warmp(20, 100)) == jc.jaxpr_hash(
-        jc.trace_jax_warmp(24, 110)
-    )
-
-
-# ---------------------------------------------------------------------------
-# Slot-stable plan maintenance: the SECOND scoped scatter exemption
-# ---------------------------------------------------------------------------
-
-
-def test_plan_apply_scatters_and_is_32bit():
-    """The plan-row apply program IS allowed scatters — it applies the
-    round's O(churn)-sized dirty plan rows + inv-order records once per
-    round — and the exemption must not be vacuous: the traced program
-    really contains scatter ops. Everything stays 32-bit."""
-    report = jc.check_jaxpr("plan_apply", jc.trace_plan_apply(5, 3))
-    assert report.scatter_eqns, (
-        "the plan-apply trace contains no scatters — the scoped "
-        "exemption is vacuous (did the program change shape?)"
-    )
-    assert report.ok_64bit, report.violations_64bit
-
-
-def test_plan_apply_pow2_record_bucket_hash_stable():
-    """Two record counts sharing a pow2 bucket trace byte-identical
-    plan-apply programs (one compiled scatter per bucket); cross-bucket
-    hashes differ (the check isn't vacuous). The graph bucket behaves
-    the same way."""
-    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2)) == jc.jaxpr_hash(
-        jc.trace_plan_apply(7, 5)
-    )
-    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2)) != jc.jaxpr_hash(
-        jc.trace_plan_apply(100, 2)
-    )
-    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2, n_raw=20, m_raw=100)) == jc.jaxpr_hash(
-        jc.trace_plan_apply(3, 2, n_raw=24, m_raw=110)
-    )
-    assert jc.jaxpr_hash(jc.trace_plan_apply(3, 2, n_raw=20, m_raw=100)) != jc.jaxpr_hash(
-        jc.trace_plan_apply(3, 2, n_raw=20, m_raw=300)
-    )
-
-
-def test_slot_stable_trace_is_distinct_scatter_free_and_bucket_stable():
-    """slot_stable=True is a DIFFERENT traced program (dead rows are
-    masked through the sign column) but still a SOLVE program: zero
-    scatters, no 64-bit, and hash-stable within a pow2 bucket (the
-    entry extent is a function of the m-bucket, never the raw size —
-    a raw-size leak here would mean a recompile per region rebuild)."""
-    closed = jc.trace_jax_slot_stable(20, 100)
-    report = jc.check_jaxpr("jax+slot_stable", closed)
-    assert report.ok_scatter, report.scatter_eqns
-    assert report.ok_64bit, report.violations_64bit
-    assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.traced("jax", 20, 100))
-    assert jc.jaxpr_hash(jc.trace_jax_slot_stable(20, 100)) == jc.jaxpr_hash(
-        jc.trace_jax_slot_stable(24, 110)
-    )
-    assert jc.jaxpr_hash(jc.trace_jax_slot_stable(20, 100)) != jc.jaxpr_hash(
-        jc.trace_jax_slot_stable(20, 300)
-    )
-
-
-def test_refit_slot_stable_combo_is_scatter_free():
-    """The production event-path program — dirty-frontier refit ON TOP
-    of the slot-stable plan (use_warm_p=True, slot_stable=True) — must
-    also stay scatter-free and 32-bit: the refit is plain data-parallel
-    Bellman relaxation over the maintained layout."""
-    closed = jc.trace_jax_warmp(20, 100, slot_stable=True)
-    report = jc.check_jaxpr("jax+refit+slot_stable", closed)
-    assert report.ok_scatter, report.scatter_eqns
-    assert report.ok_64bit, report.violations_64bit
-    assert jc.jaxpr_hash(closed) != jc.jaxpr_hash(jc.trace_jax_warmp(20, 100))
-
-
-# ---------------------------------------------------------------------------
-# Slot-stable SHARDED solve + per-shard plan apply (parallel/, ISSUE 15)
-# ---------------------------------------------------------------------------
-
-
-def test_sharded_slot_trace_no_64bit_no_scatter():
-    """The slot-stable sharded solve stays a SOLVE program: zero
-    scatters (cross-shard combines are psum/pmin/pmax of owner-masked
-    vectors), everything int32."""
-    for warm in (False, True):
-        closed = jc.trace_sharded_slot(20, 100, num_devices=2, use_warm_p=warm)
-        report = jc.check_jaxpr("sharded_slot", closed)
-        assert report.ok_scatter, (warm, report.scatter_eqns)
-        assert report.ok_64bit, (warm, report.violations_64bit)
-        assert report.num_eqns > 0
-
-
-def test_sharded_slot_shard_count_bucket_stable():
-    """One executable per (pow2 shape bucket, shard count): raw sizes
-    within a bucket trace byte-identical programs at 2, 4, AND 8
-    devices, and different shard counts trace DIFFERENT programs (each
-    mesh size is its own bucket — the bench_compare series key mirrors
-    this with mesh_devices)."""
-    per_d = {}
-    for d in (2, 4, 8):
-        ha = jc.jaxpr_hash(jc.trace_sharded_slot(20, 100, num_devices=d))
-        hb = jc.jaxpr_hash(jc.trace_sharded_slot(24, 110, num_devices=d))
-        assert ha == hb, f"{d}-dev sharded solve leaks a raw size (recompile hazard)"
-        per_d[d] = ha
-    assert len(set(per_d.values())) == 3, (
-        "different shard counts must trace different programs "
-        f"(collision: {per_d})"
-    )
-
-
-def test_sharded_slot_warm_variant_is_distinct():
-    assert jc.jaxpr_hash(jc.trace_sharded_slot(20, 100)) != jc.jaxpr_hash(
-        jc.trace_sharded_slot(20, 100, use_warm_p=True)
-    )
-
-
-def test_sharded_slot_telemetry_off_is_default_and_on_differs():
-    off = jc.jaxpr_hash(jc.trace_sharded_slot(20, 100, telemetry_cap=0))
-    on = jc.jaxpr_hash(jc.trace_sharded_slot(20, 100, telemetry_cap=512))
-    assert off == jc.jaxpr_hash(jc.trace_sharded_slot(20, 100))
-    assert on != off
-    report = jc.check_jaxpr(
-        "sharded_slot+tel", jc.trace_sharded_slot(20, 100, telemetry_cap=512)
-    )
-    assert report.ok_scatter and report.ok_64bit
-
-
-def test_sharded_superstep_ici_budget():
-    """The documented ICI shape of a sharded superstep: exactly three
-    psum families ride the solve loop (the [N] excess combine, the [M]
-    arc-delta combine, the [N] potential combine), plus the segment
-    pmin (tighten sweeps) and the phase-boundary saturate pmax — and
-    nothing else (no all_gather / all_to_all / ppermute anywhere).
-    Telemetry adds its scalar counter psums only when ON."""
-    counts = jc.count_superstep_collectives(jc.trace_sharded_slot(20, 100))
-    assert counts.get("psum", 0) == 3, counts
-    assert counts.get("pmin", 0) == 1, counts  # tighten sweep (prologue loop)
-    assert counts.get("pmax", 0) == 2, counts  # sat_full's fwd/bwd combines
-    assert not counts.get("all_gather") and not counts.get("all_to_all")
-    assert not counts.get("ppermute")
-    on = jc.count_superstep_collectives(
-        jc.trace_sharded_slot(20, 100, telemetry_cap=512)
-    )
-    assert on.get("psum", 0) > counts["psum"]  # the 4 counter psums
-
-
-def test_sharded_plan_apply_scatters_and_is_32bit():
-    """The per-shard routed plan apply is the THIRD (and last) scoped
-    scatter exemption: really scatters, all 32-bit, and contains NO
-    collectives — the owner routing happened on host, so the program
-    is embarrassingly parallel across shards."""
-    closed = jc.trace_sharded_plan_apply(5, 3)
-    report = jc.check_jaxpr("sharded_plan_apply", closed)
-    assert report.scatter_eqns, (
-        "the sharded plan-apply trace contains no scatters — the "
-        "scoped exemption is vacuous"
-    )
-    assert report.ok_64bit, report.violations_64bit
-    assert jc.count_collectives(closed) == {}
-
-
-def test_sharded_plan_apply_pow2_record_bucket_hash_stable():
-    assert jc.jaxpr_hash(jc.trace_sharded_plan_apply(3, 2)) == jc.jaxpr_hash(
-        jc.trace_sharded_plan_apply(7, 5)
-    )
-    assert jc.jaxpr_hash(jc.trace_sharded_plan_apply(3, 2)) != jc.jaxpr_hash(
-        jc.trace_sharded_plan_apply(100, 2)
-    )
-
-
-def test_sharded_plan_fingerprint_scatter_free_psummed():
-    """The sharded audit program: scatter-free, 32-bit, and its ONLY
-    collectives are the per-tensor psums that fold per-shard partials
-    into the one comparable checksum (6 entry-shaped tensors)."""
-    closed = jc.trace_sharded_plan_fingerprint()
-    report = jc.check_jaxpr("sharded_plan_fp", closed)
-    assert report.ok_scatter, report.scatter_eqns
-    assert report.ok_64bit, report.violations_64bit
-    assert jc.count_collectives(closed).get("psum", 0) == 6
-
-
-# ---------------------------------------------------------------------------
-# Multi-tenant stacked-CSR batched solve (tenancy/batch.py, ISSUE 12)
-# ---------------------------------------------------------------------------
-
-
-def test_stacked_no_64bit_no_scatter():
-    """The batched lane program stays a SOLVE program: vmap's while-
-    loop batching freezes converged lanes with selects, never
-    scatters, and everything is int32 — per-lane convergence masks
-    cost zero scatter traffic."""
-    for warm in (False, True):
-        closed = jc.trace_stacked(4, 20, 100, use_warm_p=warm)
-        report = jc.check_jaxpr("stacked", closed)
-        assert report.ok_scatter, (warm, report.scatter_eqns)
-        assert report.ok_64bit, (warm, report.violations_64bit)
-        assert report.num_eqns > 0
-
-
-def test_stacked_telemetry_variant_no_scatter():
-    report = jc.check_jaxpr(
-        "stacked", jc.trace_stacked(4, 20, 100, telemetry_cap=512)
-    )
-    assert report.ok_scatter and report.ok_64bit
-
-
-def test_stacked_lane_count_and_bucket_hash_stable():
-    """The executable-reuse contract behind the warm multi-tenant
-    process: raw sizes within a pow2 shape bucket AND raw lane counts
-    within a pow2 lane bucket trace byte-identical programs (tenant
-    churn must not recompile); cross-bucket/cross-lane-count hashes
-    differ (the check isn't vacuous)."""
-    base = jc.jaxpr_hash(jc.trace_stacked(3, 20, 100))
-    assert base == jc.jaxpr_hash(jc.trace_stacked(4, 24, 110))  # same buckets
-    assert base != jc.jaxpr_hash(jc.trace_stacked(8, 20, 100))  # lane bucket
-    assert base != jc.jaxpr_hash(jc.trace_stacked(4, 20, 300))  # shape bucket
-    from ksched_tpu.solver.jax_solver import pad_lane_count
-
-    assert pad_lane_count(3) == pad_lane_count(4) == 4
-
-
-def test_stacked_warm_variant_is_distinct():
-    """use_warm_p batches the dirty-frontier refit across lanes — a
-    DIFFERENT traced program (the warm seed is a real invar), so the
-    fresh pin above isn't accidentally covering it."""
-    assert jc.jaxpr_hash(jc.trace_stacked(4, 20, 100)) != jc.jaxpr_hash(
-        jc.trace_stacked(4, 20, 100, use_warm_p=True)
-    )
-
-
-# ---------------------------------------------------------------------------
-# Level 2: negative tests — each contract detects a seeded violation
+# Level 2: negative tests — the generic analyses detect seeded violations
 # ---------------------------------------------------------------------------
 
 
@@ -741,7 +557,6 @@ def test_contract_catches_scatter():
 
 
 def test_contract_catches_loop_gather():
-    import jax
     import jax.numpy as jnp
     from jax import lax
 
@@ -759,7 +574,6 @@ def test_contract_catches_bucket_leak():
     """A raw size leaking into a static arg splits the jaxpr hash —
     the exact failure mode of a forgotten pow2 pad."""
     import functools
-    import jax
 
     def leaky(x, scale: int = 1):
         return x * scale
@@ -772,35 +586,236 @@ def test_contract_catches_bucket_leak():
 
 
 # ---------------------------------------------------------------------------
-# State-integrity fingerprint programs (runtime/integrity.py, r14)
+# Level 3 negatives: the engine flags a seeded violation of each spec field
 # ---------------------------------------------------------------------------
 
 
-def test_fingerprint_programs_scatter_free_and_32bit():
-    """The integrity audit rides the normal round cadence, so its
-    checksum programs get NO scatter exemption: pure elementwise
-    multiply + reduction, all 32-bit. (The delta/plan scatter programs
-    themselves are untouched by fingerprinting — their off-hash pins
-    above hold byte-identically, which is the 'fingerprint-off traces
-    byte-identical to the r12 pins' contract.)"""
-    for name, trace in (
-        ("state_fingerprint", jc.trace_state_fingerprint()),
-        ("plan_fingerprint", jc.trace_plan_fingerprint()),
-    ):
-        report = jc.check_jaxpr(name, trace)
-        assert report.ok_scatter, (name, report.scatter_eqns)
-        assert report.ok_64bit, (name, report.violations_64bit)
+def test_donation_audit_catches_broken_donation():
+    """The analysis the registry exists to host: a donated input whose
+    every output needs a different dtype/shape cannot alias — XLA
+    SILENTLY copies (a UserWarning at best), and only the compiled
+    executable's input_output_alias tells the truth."""
+    import jax
+    import jax.numpy as jnp
+
+    sds = (
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+    )
+
+    def broken(a, b):
+        # no output is alias-compatible with donated `a` (f32 vs i32,
+        # scalar vs vector), so the donation is unusable
+        return a.astype(jnp.float32) * 2.0, b.sum()
+
+    rep = engine.audit_donation(jax.jit(broken, donate_argnums=(0,)), sds, (0,))
+    assert not rep.ok
+    assert 0 in rep.missing
+
+    def good(a, b):
+        return a + 1, b.sum()
+
+    rep = engine.audit_donation(jax.jit(good, donate_argnums=(0,)), sds, (0,))
+    assert rep.ok, (rep.missing, rep.unusable_warnings, rep.header)
+    assert 0 in rep.aliased_params
 
 
-def test_fingerprint_programs_pow2_bucket_hash_stable():
-    """One compiled fingerprint program per pow2 shape bucket — the
-    audit must never force per-round recompiles."""
-    assert jc.jaxpr_hash(jc.trace_state_fingerprint(20, 100)) == jc.jaxpr_hash(
-        jc.trace_state_fingerprint(24, 110)
+def test_donation_check_fails_on_undeclared_argnum():
+    """Auditing MORE argnums than the program donates must fail — the
+    registry can't claim in-place behavior the executable lacks."""
+    spec = dataclasses.replace(
+        PROGRAMS["delta_apply"],
+        donation=DonationSpec(donate_argnums=(0, 1, 2, 3, 4), builder="aot_delta_apply"),
     )
-    assert jc.jaxpr_hash(jc.trace_state_fingerprint(20, 100)) != jc.jaxpr_hash(
-        jc.trace_state_fingerprint(20, 300)
+    with pytest.raises(engine.ContractError, match="NOT aliased"):
+        engine.check_donation(spec)
+
+
+def test_donation_check_fails_on_missing_builder():
+    spec = dataclasses.replace(
+        PROGRAMS["delta_apply"],
+        donation=DonationSpec(donate_argnums=(0,), builder="aot_no_such_builder"),
     )
-    assert jc.jaxpr_hash(jc.trace_plan_fingerprint(20, 100)) == jc.jaxpr_hash(
-        jc.trace_plan_fingerprint(24, 110)
+    with pytest.raises(engine.ContractError, match="builder"):
+        engine.check_donation(spec)
+
+
+def test_engine_flags_forbidden_scatter():
+    spec = dataclasses.replace(PROGRAMS["delta_apply"], scatter_policy="forbidden")
+    with pytest.raises(engine.ContractError, match="forbidden"):
+        engine.check_contracts(spec)
+
+
+def test_engine_flags_vacuous_scatter_exemption():
+    spec = dataclasses.replace(
+        PROGRAMS["warm_flow"], kind="maintenance", scatter_policy="scoped-exempt"
     )
+    with pytest.raises(engine.ContractError, match="VACUOUS"):
+        engine.check_contracts(spec)
+
+
+def test_engine_flags_collective_budget_mismatch():
+    spec = dataclasses.replace(
+        PROGRAMS["sharded_slot_solve"],
+        collectives=CollectiveBudget(loop=(("psum", 99),)),
+    )
+    with pytest.raises(engine.ContractError, match="psum count"):
+        engine.check_contracts(spec)
+
+
+def test_engine_flags_forbidden_collective():
+    spec = dataclasses.replace(
+        PROGRAMS["sharded_slot_solve"],
+        collectives=CollectiveBudget(forbidden=("psum",)),
+    )
+    with pytest.raises(engine.ContractError, match="forbidden collective"):
+        engine.check_contracts(spec)
+
+
+def test_engine_flags_hash_pin_mismatch():
+    spec = dataclasses.replace(
+        PROGRAMS["csr_solve"], telemetry_off_hash="0000000000000000"
+    )
+    with pytest.raises(engine.ContractError, match="pinned"):
+        engine.check_hash_pin(spec)
+
+
+def test_engine_flags_cross_bucket_hash_split():
+    """A `same` pair straddling two buckets must fail (and proves the
+    stability check isn't comparing a hash to itself)."""
+    spec = dataclasses.replace(
+        PROGRAMS["csr_solve"],
+        hash_stability=HashStability(
+            "pow2-bucket", same=((call(12, 40), call(12, 200)),)
+        ),
+    )
+    with pytest.raises(engine.ContractError, match="recompile hazard"):
+        engine.check_hash_stability(spec)
+
+
+def test_engine_flags_vacuous_cross_pair():
+    spec = dataclasses.replace(
+        PROGRAMS["csr_solve"],
+        hash_stability=HashStability(
+            "pow2-bucket", cross=((call(12, 40), call(15, 60)),)
+        ),
+    )
+    with pytest.raises(engine.ContractError, match="vacuous"):
+        engine.check_hash_stability(spec)
+
+
+def test_engine_flags_vacuous_distinct_variant():
+    spec = dataclasses.replace(PROGRAMS["csr_solve"], distinct_from=("csr_solve",))
+    with pytest.raises(engine.ContractError, match="collides"):
+        engine.check_distinct(spec)
+
+
+def test_engine_flags_undeclared_ownership():
+    spec = dataclasses.replace(PROGRAMS["csr_solve"], module="ksched_tpu.solver.base")
+    with pytest.raises(engine.ContractError, match="declare_programs"):
+        engine.check_declared(spec)
+
+
+def test_engine_flags_missing_tracer():
+    spec = dataclasses.replace(PROGRAMS["csr_solve"], tracer="trace_no_such_thing")
+    with pytest.raises(engine.ContractError, match="does not exist"):
+        engine.check_contracts(spec)
+
+
+def test_registry_rejects_bad_vocabulary():
+    with pytest.raises(ValueError, match="scatter policy"):
+        dataclasses.replace(PROGRAMS["csr_solve"], scatter_policy="whatever")
+    with pytest.raises(ValueError, match="dtype policy"):
+        dataclasses.replace(PROGRAMS["csr_solve"], dtype_policy="int64")
+    with pytest.raises(ValueError, match="reason"):
+        HashStability("exempt")
+    with pytest.raises(ValueError, match="kind"):
+        HashStability("no-such-kind")
+
+
+def test_declare_programs_rejects_typo_eagerly():
+    from ksched_tpu.analysis.program_registry import declare_programs
+
+    with pytest.raises(ValueError, match="unregistered program"):
+        declare_programs("tests._fake_module", "csr_slove")
+
+
+# ---------------------------------------------------------------------------
+# Level 3 satellites: CLI flags
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*argv, timeout=120):
+    """Drive the CLI in-process (argparse + real repo walk, no
+    interpreter spawn — the end-to-end subprocess path is covered once
+    by test_cli_exits_zero)."""
+    import contextlib
+    import io
+
+    from tools import kschedlint
+
+    out, err = io.StringIO(), io.StringIO()
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+            try:
+                rc = kschedlint.main(list(argv))
+            except SystemExit as e:
+                rc = e.code if isinstance(e.code, int) else 2
+    finally:
+        os.chdir(cwd)
+    return subprocess.CompletedProcess(argv, rc, out.getvalue(), err.getvalue())
+
+
+def test_cli_unknown_rule_exits_2():
+    proc = _run_cli("--rules", "dtype64,no-such-rule", "tools")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_rules_subset_runs():
+    proc = _run_cli("--rules", "dtype64,raw-print", "tools", "bench.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 rules" in proc.stderr
+
+
+def test_cli_coverage_summary_line():
+    proc = _run_cli("--coverage", "ksched_tpu", "tools", "bench.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"kschedlint L3: {len(PROGRAMS)} programs registered" in proc.stderr
+    assert "0 unaudited" in proc.stderr
+
+
+def test_cli_json_mode(tmp_path):
+    proc = _run_cli("--json", "--coverage", "ksched_tpu", "tools", "bench.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["new"] == [] and payload["stale_baseline"] == []
+    cov = payload["coverage"]
+    assert cov["unaudited"] == [] and cov["unannotated_registered"] == []
+    assert cov["programs_registered"] == len(PROGRAMS)
+    assert cov["sites"] == len(cov["annotated"]) + len(cov["waived"])
+
+
+def test_cli_stale_baseline_fails_and_prune_sheds(tmp_path):
+    """The shrink-only ratchet: a baseline entry matching no current
+    violation is an ERROR (the debt was paid; the entry would silently
+    excuse a regression), and --prune-baseline sheds exactly those
+    entries without admitting anything new."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "clean.py").write_text("def f():\n    return 1\n")
+    stale = tmp_path / "baseline.json"
+    stale.write_text(json.dumps({
+        "violations": [
+            {"path": "pkg/gone.py", "rule": "dtype64", "hash": "0" * 16}
+        ]
+    }))
+    proc = _run_cli("--baseline", str(stale), str(tree))
+    assert proc.returncode == 1
+    assert "stale baseline" in proc.stderr
+    proc = _run_cli("--prune-baseline", "--baseline", str(stale), str(tree))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(stale.read_text())["violations"] == []
